@@ -1,0 +1,30 @@
+"""fia_tpu — a TPU-native Fast Influence Analysis framework.
+
+A from-scratch JAX/XLA re-design of the capabilities of the FIA (KDD'19)
+reference codebase (``zz9tf/FIA-KDD-19``): latent-factor recommenders (MF,
+NCF) trained on explicit ratings, a generic influence-function engine
+(per-example gradients, Hessian-vector products, inverse-HVP via CG /
+LiSSA / direct solve), and the FIA block-restricted fast path that
+computes the influence of training interactions on a test prediction in
+the (user, item) embedding sub-block only.
+
+Design notes (vs the reference, see SURVEY.md):
+  - Parameters are pytrees of dense (U,k)/(I,k) matrices; the reference's
+    flat-1D-variable + slice trick (reference
+    ``src/influence/matrix_factorization.py:152-162``) becomes functional
+    row indexing + AD.
+  - The reference mutates its TF1 graph per test point (lazy op creation,
+    ``matrix_factorization.py:183-198``); here an influence query is a pure
+    jitted function of (u*, i*), compiled once and vmapped over test points.
+  - Scoring (one sess.run per train row in the reference,
+    ``matrix_factorization.py:240-246``) is a single vmapped per-example
+    gradient batch followed by one matvec.
+  - Scaling is expressed with ``jax.sharding`` over a device Mesh
+    (data-parallel test-query batches, optionally sharded embedding
+    tables) instead of any session/device pinning.
+"""
+
+__version__ = "0.1.0"
+
+from fia_tpu.models import MF, NCF  # noqa: F401
+from fia_tpu.influence.engine import InfluenceEngine  # noqa: F401

@@ -1,0 +1,116 @@
+"""In-memory explicit-rating dataset.
+
+Capability parity with the reference ``src/influence/dataset.py:5-70``
+(``DataSet``: epoch-shuffled minibatching over a stable base array,
+mutation helpers), re-designed for a JAX trainer: the host-side object is
+numpy-backed for IO and mutation; batch *schedules* are materialised as
+whole-epoch index permutations so the device-side training loop can
+``lax.scan`` over exact-shape batches without host round-trips.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class RatingDataset:
+    """(user, item) -> rating triples.
+
+    Attributes:
+      x: int32 array (N, 2) of (user_id, item_id).
+      y: float32 array (N,) of ratings.
+    """
+
+    def __init__(self, x: np.ndarray, y: np.ndarray):
+        x = np.asarray(x)
+        if x.ndim > 2:
+            x = x.reshape(x.shape[0], -1)
+        self.x = np.ascontiguousarray(x, dtype=np.int32)
+        self.y = np.ascontiguousarray(np.asarray(y).reshape(-1), dtype=np.float32)
+        if self.x.shape[0] != self.y.shape[0]:
+            raise ValueError(
+                f"x and y disagree on N: {self.x.shape[0]} vs {self.y.shape[0]}"
+            )
+        self._order = np.arange(self.num_examples)
+        self._cursor = 0
+        self._epochs_completed = 0
+        self._rng = np.random.default_rng(0)
+
+    # -- basic protocol ----------------------------------------------------
+    @property
+    def num_examples(self) -> int:
+        return self.x.shape[0]
+
+    @property
+    def num_users(self) -> int:
+        return int(self.x[:, 0].max()) + 1 if self.num_examples else 0
+
+    @property
+    def num_items(self) -> int:
+        return int(self.x[:, 1].max()) + 1 if self.num_examples else 0
+
+    # labels alias for parity with the reference DataSet API
+    @property
+    def labels(self) -> np.ndarray:
+        return self.y
+
+    def __len__(self) -> int:
+        return self.num_examples
+
+    def __repr__(self) -> str:
+        return (
+            f"RatingDataset(N={self.num_examples}, users={self.num_users}, "
+            f"items={self.num_items})"
+        )
+
+    # -- host-side minibatching (reference dataset.py:44-70 semantics) -----
+    def reset_batch(self, seed: int = 0) -> None:
+        """Reset the epoch cursor and the shuffle stream."""
+        self._cursor = 0
+        self._epochs_completed = 0
+        self._order = np.arange(self.num_examples)
+        self._rng = np.random.default_rng(seed)
+
+    def next_batch(self, batch_size: int) -> tuple[np.ndarray, np.ndarray]:
+        """Sequential minibatch; reshuffles on epoch wrap and truncates a
+        ragged tail (reference ``dataset.py:49-70``)."""
+        if batch_size > self.num_examples:
+            raise ValueError("batch_size larger than the dataset")
+        if self._cursor + batch_size > self.num_examples:
+            self._epochs_completed += 1
+            self._rng.shuffle(self._order)
+            self._cursor = 0
+        sel = self._order[self._cursor : self._cursor + batch_size]
+        self._cursor += batch_size
+        return self.x[sel], self.y[sel]
+
+    def epoch_schedule(self, batch_size: int, seed: int) -> np.ndarray:
+        """A full epoch of batch indices, shape (num_batches, batch_size).
+
+        The ragged tail is dropped, matching the reference's tail
+        truncation. This is the host-side companion of the device trainer:
+        the returned index matrix is scanned on device.
+        """
+        order = np.random.default_rng(seed).permutation(self.num_examples)
+        nb = self.num_examples // batch_size
+        return order[: nb * batch_size].reshape(nb, batch_size)
+
+    # -- mutation helpers (reference dataset.py:35-47, 73-90) --------------
+    def append_one_case(self, x_row: np.ndarray, y_val: float) -> None:
+        self.x = np.concatenate(
+            [self.x, np.asarray(x_row, dtype=np.int32).reshape(1, -1)], axis=0
+        )
+        self.y = np.concatenate(
+            [self.y, np.asarray([y_val], dtype=np.float32)], axis=0
+        )
+        self.reset_batch()
+
+    def without(self, indices) -> "RatingDataset":
+        """A copy with the given row indices removed (leave-one-out)."""
+        keep = np.ones(self.num_examples, dtype=bool)
+        keep[np.asarray(indices)] = False
+        return RatingDataset(self.x[keep], self.y[keep])
+
+    def subset(self, indices) -> "RatingDataset":
+        idx = np.asarray(indices)
+        return RatingDataset(self.x[idx], self.y[idx])

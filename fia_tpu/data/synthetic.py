@@ -1,0 +1,93 @@
+"""Synthetic explicit-rating generators.
+
+The reference repo ships valid/test splits but its two training files are
+stripped (``/root/reference/.MISSING_LARGE_BLOBS:1-2``), so end-to-end
+runs regenerate a training split: ratings are sampled from a planted
+low-rank MF model plus noise, quantised to the 1-5 star scale the real
+files use. Deterministic given the seed.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from fia_tpu.data.dataset import RatingDataset
+
+
+def synthesize_ratings(
+    num_users: int,
+    num_items: int,
+    num_rows: int,
+    seed: int = 0,
+    rank: int = 8,
+    noise: float = 0.4,
+    ensure_cover: np.ndarray | None = None,
+) -> RatingDataset:
+    """Sample ``num_rows`` (user, item, rating) triples.
+
+    Users/items are drawn from Zipf-ish popularity marginals (real rating
+    data is heavy-tailed, and the FIA related-set sizes depend on that
+    skew). ``ensure_cover`` is an optional (M, 2) array of (u, i) pairs —
+    e.g. the test split — each of whose users and items is guaranteed at
+    least one training interaction so every test query has a non-empty
+    related set.
+    """
+    rng = np.random.default_rng(seed)
+
+    def _zipf_choice(n, size):
+        w = 1.0 / np.arange(1, n + 1) ** 0.8
+        w /= w.sum()
+        perm = rng.permutation(n)  # decouple popularity from id order
+        return perm[rng.choice(n, size=size, p=w)]
+
+    users = _zipf_choice(num_users, num_rows)
+    items = _zipf_choice(num_items, num_rows)
+
+    if ensure_cover is not None and len(ensure_cover):
+        cover = np.asarray(ensure_cover)
+        cu = np.unique(cover[:, 0])
+        ci = np.unique(cover[:, 1])
+        need = len(cu) + len(ci)
+        if need > num_rows:
+            raise ValueError("num_rows too small to cover the given pairs")
+        users[: len(cu)] = cu
+        items[: len(cu)] = rng.integers(0, num_items, size=len(cu))
+        users[len(cu) : need] = rng.integers(0, num_users, size=len(ci))
+        items[len(cu) : need] = ci
+
+    # Planted MF structure: r = clip(round(mu + b_u + b_i + p_u.q_i + eps), 1, 5)
+    p = rng.normal(0, 1.0 / np.sqrt(rank), size=(num_users, rank))
+    q = rng.normal(0, 1.0 / np.sqrt(rank), size=(num_items, rank))
+    bu = rng.normal(0, 0.3, size=num_users)
+    bi = rng.normal(0, 0.3, size=num_items)
+    scores = (
+        3.5
+        + bu[users]
+        + bi[items]
+        + np.einsum("nk,nk->n", p[users], q[items])
+        + rng.normal(0, noise, size=num_rows)
+    )
+    ratings = np.clip(np.rint(scores), 1.0, 5.0).astype(np.float32)
+
+    x = np.stack([users, items], axis=1).astype(np.int32)
+    return RatingDataset(x, ratings)
+
+
+def synthetic_splits(
+    num_users: int,
+    num_items: int,
+    num_train: int,
+    num_test: int,
+    seed: int = 0,
+    **kw,
+) -> dict[str, RatingDataset]:
+    """Train/validation/test splits from one planted model."""
+    full = synthesize_ratings(
+        num_users, num_items, num_train + 2 * num_test, seed=seed, **kw
+    )
+    train = RatingDataset(full.x[: num_train], full.y[: num_train])
+    valid = RatingDataset(
+        full.x[num_train : num_train + num_test], full.y[num_train : num_train + num_test]
+    )
+    test = RatingDataset(full.x[num_train + num_test :], full.y[num_train + num_test :])
+    return {"train": train, "validation": valid, "test": test}

@@ -1,0 +1,2 @@
+from fia_tpu.influence.engine import InfluenceEngine, InfluenceResult  # noqa: F401
+from fia_tpu.influence import grads, hvp, solvers  # noqa: F401

@@ -1,0 +1,78 @@
+"""Gradient primitives for influence analysis.
+
+Replaces the reference's graph-level ``tf.gradients`` ops and the
+one-``sess.run``-per-train-row scoring loop
+(``matrix_factorization.py:240-246``) with vmapped per-example gradients.
+All functions return *flattened* block vectors (d = model.block_size) so
+solvers and scoring are plain linear algebra.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def block_prediction_grad(model, params, u, i, x):
+    """∇_block of the mean predicted rating over rows ``x``.
+
+    This is the FIA test-side vector v (reference ``grad_loss_r`` sliced
+    by ``get_test_grad``, ``genericNeuralNet.py:155`` +
+    ``matrix_factorization.py:152-162, 253-286``).
+    """
+    block0 = model.extract_block(params, u, i)
+
+    def mean_pred(bvec):
+        block = model.unflatten_block(bvec, block0)
+        return jnp.mean(model.block_predict(params, block, u, i, x))
+
+    return jax.grad(mean_pred)(model.flatten_block(block0))
+
+
+def block_loss_grad(model, params, u, i, x, y, w=None):
+    """∇_block of the total loss ((masked-)mean MSE + L2) over rows x."""
+    block0 = model.extract_block(params, u, i)
+
+    def total(bvec):
+        block = model.unflatten_block(bvec, block0)
+        return model.block_loss(params, block, u, i, x, y, w)
+
+    return jax.grad(total)(model.flatten_block(block0))
+
+
+def per_example_block_loss_grads(model, params, u, i, x, y):
+    """(B, d) matrix of ∇_block L(z_j) for each row j fed alone.
+
+    Matches the reference's per-row feeds of ``grad_total_loss_op``
+    sliced to the block (``matrix_factorization.py:240-246``): each row's
+    loss is its own squared error plus the *full* regulariser, so every
+    row's gradient carries the same wd * θ_block term.
+    """
+    block0 = model.extract_block(params, u, i)
+    bvec0 = model.flatten_block(block0)
+
+    def one(xj, yj):
+        def total(bvec):
+            block = model.unflatten_block(bvec, block0)
+            return model.block_loss(params, block, u, i, xj[None, :], yj[None])
+
+        return jax.grad(total)(bvec0)
+
+    return jax.vmap(one)(x, y)
+
+
+def per_example_full_loss_grads(model, params, x, y):
+    """(B,) pytree-of-stacked per-example full-parameter loss gradients."""
+
+    def one(xj, yj):
+        return jax.grad(lambda p: model.loss(p, xj[None, :], yj[None]))(params)
+
+    return jax.vmap(one)(x, y)
+
+
+def full_loss_grad(model, params, x, y, w=None):
+    return jax.grad(lambda p: model.loss(p, x, y, w))(params)
+
+
+def full_loss_no_reg_grad(model, params, x, y, w=None):
+    return jax.grad(lambda p: model.loss_no_reg(p, x, y, w))(params)

@@ -1,0 +1,5 @@
+from fia_tpu.models.base import LatentFactorModel  # noqa: F401
+from fia_tpu.models.mf import MF  # noqa: F401
+from fia_tpu.models.ncf import NCF  # noqa: F401
+
+MODELS = {"MF": MF, "NCF": NCF}

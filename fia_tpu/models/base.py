@@ -1,0 +1,135 @@
+"""Model interface for latent-factor recommenders.
+
+The reference expresses models as TF1 graph-builder subclasses of a
+template-method base (``src/influence/genericNeuralNet.py:82-180``) whose
+parameters are flat 1-D variables sliced by hand for the FIA block
+restriction (``matrix_factorization.py:152-162``). Here a model is a
+small object exposing *pure functions* over a parameter pytree:
+
+  - ``init_params(key)``       -> params pytree
+  - ``predict(params, x)``     -> (B,) predicted ratings
+  - ``loss(params, x, y)``     -> scalar total loss (masked-mean MSE + L2)
+  - ``extract_block/with_block`` -> the FIA (user, item) parameter
+    sub-block as a pytree, written back functionally so block-restricted
+    gradients and Hessians fall out of ordinary AD instead of slicing.
+
+Everything is jit/vmap/shard-friendly: (u, i) may be traced scalars.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+Params = Any  # pytree of jnp arrays
+Block = Any  # pytree of jnp arrays (the FIA sub-block)
+
+
+def truncated_normal(key, shape, stddev, dtype=jnp.float32):
+    """TF-style truncated normal: resample beyond 2 sigma."""
+    return stddev * jax.random.truncated_normal(key, -2.0, 2.0, shape, dtype)
+
+
+class LatentFactorModel:
+    """Base class; subclasses define the forward pass and the FIA block."""
+
+    #: params that carry L2 weight decay (reference
+    #: ``genericNeuralNet.py:40-65``: wd * l2_loss = wd * 0.5 * sum(w^2)).
+    decayed: tuple[str, ...] = ()
+
+    def __init__(self, num_users: int, num_items: int, embedding_size: int,
+                 weight_decay: float):
+        self.num_users = int(num_users)
+        self.num_items = int(num_items)
+        self.embedding_size = int(embedding_size)
+        self.weight_decay = float(weight_decay)
+
+    # -- subclass hooks ----------------------------------------------------
+    def init_params(self, key) -> Params:
+        raise NotImplementedError
+
+    def predict(self, params: Params, x) -> jnp.ndarray:
+        """x: (B, 2) int32 (user, item) -> (B,) float ratings."""
+        raise NotImplementedError
+
+    def extract_block(self, params: Params, u, i) -> Block:
+        raise NotImplementedError
+
+    def with_block(self, params: Params, block: Block, u, i) -> Params:
+        raise NotImplementedError
+
+    @property
+    def block_size(self) -> int:
+        raise NotImplementedError
+
+    # -- generic functions -------------------------------------------------
+    def reg_loss(self, params: Params) -> jnp.ndarray:
+        reg = jnp.asarray(0.0, jnp.float32)
+        for name in self.decayed:
+            reg = reg + 0.5 * jnp.sum(jnp.square(params[name]))
+        return self.weight_decay * reg
+
+    def indiv_loss(self, params: Params, x, y) -> jnp.ndarray:
+        """Per-example squared error, (B,)."""
+        return jnp.square(self.predict(params, x) - y)
+
+    def loss(self, params: Params, x, y, w=None) -> jnp.ndarray:
+        """Total training loss: (weighted-)mean squared error + L2.
+
+        ``w`` is an optional (B,) weight/mask vector: the mean is then
+        sum(w * err) / sum(w), which reproduces the reference's plain mean
+        over whichever rows were fed (``matrix_factorization.py:122-132``)
+        while letting padded/batched callers mask rows out.
+        """
+        err = self.indiv_loss(params, x, y)
+        if w is None:
+            mse = jnp.mean(err)
+        else:
+            w = w.astype(err.dtype)
+            mse = jnp.sum(w * err) / jnp.maximum(jnp.sum(w), 1.0)
+        return mse + self.reg_loss(params)
+
+    def loss_no_reg(self, params: Params, x, y, w=None) -> jnp.ndarray:
+        err = self.indiv_loss(params, x, y)
+        if w is None:
+            return jnp.mean(err)
+        w = w.astype(err.dtype)
+        return jnp.sum(w * err) / jnp.maximum(jnp.sum(w), 1.0)
+
+    def mae(self, params: Params, x, y) -> jnp.ndarray:
+        """Reference 'accuracy' op (``matrix_factorization.py:134-146``)."""
+        return jnp.mean(jnp.abs(self.predict(params, x) - y))
+
+    def num_params(self) -> int:
+        shapes = jax.eval_shape(self.init_params, jax.random.PRNGKey(0))
+        return sum(math.prod(l.shape) for l in jax.tree_util.tree_leaves(shapes))
+
+    # -- block helpers -----------------------------------------------------
+    def block_predict(self, params: Params, block: Block, u, i, x) -> jnp.ndarray:
+        """Predict with the (u, i) block functionally substituted.
+
+        Differentiating w.r.t. ``block`` yields exactly the reference's
+        block-restricted gradients (its get_test_grad slicing,
+        ``matrix_factorization.py:152-162``) because all other parameters
+        are constants of the closure.
+        """
+        return self.predict(self.with_block(params, block, u, i), x)
+
+    def block_loss(self, params: Params, block: Block, u, i, x, y, w=None):
+        return self.loss(self.with_block(params, block, u, i), x, y, w)
+
+    def flatten_block(self, block: Block) -> jnp.ndarray:
+        leaves = jax.tree_util.tree_leaves(block)
+        return jnp.concatenate([jnp.ravel(l) for l in leaves])
+
+    def unflatten_block(self, vec: jnp.ndarray, like: Block) -> Block:
+        leaves, treedef = jax.tree_util.tree_flatten(like)
+        out, pos = [], 0
+        for l in leaves:
+            n = math.prod(l.shape)
+            out.append(jnp.reshape(vec[pos : pos + n], l.shape))
+            pos += n
+        return jax.tree_util.tree_unflatten(treedef, out)

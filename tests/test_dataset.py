@@ -1,0 +1,116 @@
+import numpy as np
+import pytest
+
+from fia_tpu.data.dataset import RatingDataset
+from fia_tpu.data.index import InteractionIndex
+from fia_tpu.data.synthetic import synthesize_ratings
+
+
+def _ds(n=100, users=10, items=8, seed=0):
+    rng = np.random.default_rng(seed)
+    x = np.stack(
+        [rng.integers(0, users, n), rng.integers(0, items, n)], axis=1
+    ).astype(np.int32)
+    y = rng.integers(1, 6, n).astype(np.float32)
+    return RatingDataset(x, y)
+
+
+class TestRatingDataset:
+    def test_shapes_and_casts(self):
+        ds = _ds()
+        assert ds.x.dtype == np.int32 and ds.y.dtype == np.float32
+        assert ds.num_examples == 100
+
+    def test_next_batch_covers_epoch(self):
+        ds = _ds(n=90)
+        seen = []
+        for _ in range(9):
+            bx, _ = ds.next_batch(10)
+            seen.append(bx)
+        # first epoch is unshuffled: concatenation equals the base array
+        assert np.array_equal(np.concatenate(seen), ds.x)
+
+    def test_next_batch_reshuffles_on_wrap(self):
+        ds = _ds(n=90)
+        for _ in range(9):
+            ds.next_batch(10)
+        bx, _ = ds.next_batch(10)
+        assert bx.shape == (10, 2)
+
+    def test_tail_truncation(self):
+        # batch that doesn't divide N: wrap happens early, tail dropped
+        ds = _ds(n=95)
+        for _ in range(20):
+            bx, by = ds.next_batch(10)
+            assert bx.shape == (10, 2) and by.shape == (10,)
+
+    def test_epoch_schedule_exact(self):
+        ds = _ds(n=95)
+        sched = ds.epoch_schedule(10, seed=1)
+        assert sched.shape == (9, 10)
+        assert len(np.unique(sched)) == 90
+
+    def test_append_and_without(self):
+        ds = _ds(n=20)
+        ds.append_one_case(np.array([3, 4]), 5.0)
+        assert ds.num_examples == 21
+        assert ds.x[-1].tolist() == [3, 4]
+        ds2 = ds.without([0, 1])
+        assert ds2.num_examples == 19
+
+    def test_mismatched_lengths_raise(self):
+        with pytest.raises(ValueError):
+            RatingDataset(np.zeros((3, 2)), np.zeros(4))
+
+
+class TestInteractionIndex:
+    def test_related_matches_bruteforce(self):
+        ds = _ds(n=300, users=12, items=9, seed=2)
+        idx = InteractionIndex(ds.x)
+        for u, i in [(0, 0), (3, 5), (11, 8)]:
+            got = np.sort(idx.related(u, i))
+            want = np.sort(
+                np.concatenate(
+                    [
+                        np.where(ds.x[:, 0] == u)[0],
+                        np.where(ds.x[:, 1] == i)[0],
+                    ]
+                )
+            )
+            assert np.array_equal(got, want)
+
+    def test_duplicate_row_kept(self):
+        # a row matching user AND item appears twice (reference semantics)
+        x = np.array([[1, 1], [1, 2], [2, 1]], dtype=np.int32)
+        idx = InteractionIndex(x, num_users=3, num_items=3)
+        rel = idx.related(1, 1)
+        assert (rel == 0).sum() == 2
+
+    def test_related_padded(self):
+        ds = _ds(n=300, users=12, items=9, seed=2)
+        idx = InteractionIndex(ds.x)
+        pts = np.array([[0, 0], [3, 5]])
+        ridx, mask, counts = idx.related_padded(pts, bucket=16)
+        assert ridx.shape == mask.shape
+        assert ridx.shape[1] % 16 == 0
+        for t, (u, i) in enumerate(pts):
+            assert counts[t] == idx.related_count(u, i)
+            assert np.array_equal(ridx[t, : counts[t]], idx.related(u, i))
+            assert mask[t, : counts[t]].all() and not mask[t, counts[t] :].any()
+
+
+class TestSynthetic:
+    def test_cover(self):
+        cover = np.array([[7, 3], [9, 1]])
+        ds = synthesize_ratings(10, 5, 200, seed=0, ensure_cover=cover)
+        assert ds.num_examples == 200
+        assert (ds.y >= 1).all() and (ds.y <= 5).all()
+        for u in cover[:, 0]:
+            assert (ds.x[:, 0] == u).any()
+        for i in cover[:, 1]:
+            assert (ds.x[:, 1] == i).any()
+
+    def test_deterministic(self):
+        a = synthesize_ratings(10, 5, 100, seed=4)
+        b = synthesize_ratings(10, 5, 100, seed=4)
+        assert np.array_equal(a.x, b.x) and np.array_equal(a.y, b.y)
