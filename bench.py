@@ -1,0 +1,106 @@
+"""Benchmark: FIA influence-query throughput at ML-1M scale.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "influence-scores/sec",
+   "vs_baseline": N, ...}
+
+Workload (BASELINE.md): MF k=16 on an ML-1M-scale dataset (975,460 train
+rows, 6,040 users, 3,706 items — train split synthesized; the reference's
+train blob is stripped from its repo). The JAX engine runs a batch of
+influence queries on the default JAX platform (the TPU chip under the
+driver); the baseline is the torch-CPU reference-architecture engine
+(fmin_ncg + per-row scoring loop) timed on a sample of the same queries.
+``vs_baseline`` is the throughput ratio; the JSON also reports the
+Spearman rank-correlation parity between the two engines' scores.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+QUICK = "--quick" in sys.argv
+
+
+def main():
+    import jax
+
+    from fia_tpu.backends.torch_ref import TorchRefMFEngine
+    from fia_tpu.data.synthetic import synthesize_ratings
+    from fia_tpu.eval.metrics import spearman
+    from fia_tpu.eval.rq2 import time_influence_queries
+    from fia_tpu.influence.engine import InfluenceEngine
+    from fia_tpu.models import MF
+    from fia_tpu.train.trainer import Trainer, TrainConfig
+
+    if QUICK:
+        users, items, rows, steps, n_queries, n_base = 600, 400, 50_000, 500, 64, 2
+    else:
+        users, items, rows, steps, n_queries, n_base = (
+            6_040, 3_706, 975_460, 1_000, 256, 4
+        )
+    k, wd, damping, lr, batch = 16, 1e-3, 1e-6, 1e-3, 3020
+
+    train = synthesize_ratings(users, items, rows, seed=0)
+    model = MF(users, items, k, wd)
+    params = model.init_params(jax.random.PRNGKey(0))
+
+    # brief training so the block Hessians look like the real workload's
+    tr = Trainer(model, TrainConfig(batch_size=batch, num_steps=steps,
+                                    learning_rate=lr))
+    state = tr.fit(tr.init_state(params), train.x, train.y)
+    params = state.params
+
+    engine = InfluenceEngine(model, params, train, damping=damping,
+                             solver="direct", pad_bucket=512)
+    rng = np.random.default_rng(17)
+    qu = rng.integers(0, users, n_queries)
+    qi = rng.integers(0, items, n_queries)
+    points = np.stack([qu, qi], axis=1).astype(np.int32)
+
+    timing = time_influence_queries(engine, points, repeats=3)
+
+    # --- CPU baseline (reference-architecture engine) on a sample -------
+    host = jax.tree_util.tree_map(np.asarray, params)
+    ref = TorchRefMFEngine(host, train.x, train.y, weight_decay=wd,
+                           damping=damping)
+    base_scores_total = 0
+    base_time = 0.0
+    rhos = []
+    res = engine.query_batch(points[:n_base])
+    for t in range(n_base):
+        u, i = int(points[t, 0]), int(points[t, 1])
+        t0 = time.perf_counter()
+        ref_scores, ref_rows = ref.query(u, i)
+        base_time += time.perf_counter() - t0
+        base_scores_total += len(ref_rows)
+        rhos.append(spearman(res.scores_of(t), ref_scores))
+
+    base_scores_per_sec = base_scores_total / base_time
+    vs_baseline = timing.scores_per_sec / base_scores_per_sec
+
+    out = {
+        "metric": "fia-influence-scores/sec (MF k=16, ML-1M scale)",
+        "value": round(timing.scores_per_sec, 1),
+        "unit": "scores/sec",
+        "vs_baseline": round(vs_baseline, 2),
+        "details": {
+            "backend": jax.default_backend(),
+            "queries_per_sec": round(timing.queries_per_sec, 2),
+            "per_query_ms": round(timing.per_query_ms, 3),
+            "compile_s": round(timing.compile_time_s, 2),
+            "num_queries": timing.num_queries,
+            "num_scores": timing.num_scores,
+            "cpu_ref_scores_per_sec": round(base_scores_per_sec, 1),
+            "spearman_vs_cpu_ref_min": round(float(min(rhos)), 4),
+        },
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,7 @@
+"""Backend selector: the JAX/TPU engine is the product; the torch-CPU
+reference engine mirrors the reference implementation's architecture
+(autograd double-backprop + scipy fmin_ncg + per-row scoring loop) and
+serves as the parity oracle and the benchmark baseline (BASELINE.md §3:
+measure our own CPU baseline, report speedups against it)."""
+
+from fia_tpu.backends.torch_ref import TorchRefMFEngine  # noqa: F401

@@ -1,0 +1,155 @@
+"""Shared CLI plumbing for the RQ drivers.
+
+The reference wrote an argparse block and then commented it out, so its
+shell sweeps silently all ran one hardcoded config (SURVEY.md §2.3).
+Here the same knob names (``RQ1.py:18-34``) are real flags, plus
+``--backend`` (north-star requirement) and the solver/scale knobs this
+framework adds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import numpy as np
+
+from fia_tpu.data.loaders import load_dataset
+from fia_tpu.data.synthetic import synthetic_splits
+from fia_tpu.models import MODELS
+from fia_tpu.train.trainer import Trainer, TrainConfig
+from fia_tpu.train import checkpoint
+
+# Reference batch sizes: exact divisors of the train-set sizes
+# (RQ1.py:68, 71).
+BATCH_SIZES = {"movielens": 3020, "yelp": 3009}
+
+
+def base_parser(desc: str) -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=desc)
+    # reference knobs (names preserved)
+    p.add_argument("--avextol", type=float, default=1e-3,
+                   help="solver tolerance for the influence solve")
+    p.add_argument("--damping", type=float, default=1e-6)
+    p.add_argument("--weight_decay", type=float, default=1e-3)
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--embed_size", type=int, default=16)
+    p.add_argument("--maxinf", type=int, default=1,
+                   help="1: remove most-influential rows; 0: random")
+    p.add_argument("--dataset", type=str, default="movielens",
+                   choices=["movielens", "yelp", "synthetic"])
+    p.add_argument("--model", type=str, default="MF", choices=["MF", "NCF"])
+    p.add_argument("--num_test", type=int, default=5)
+    p.add_argument("--num_steps_train", type=int, default=80_000)
+    p.add_argument("--num_steps_retrain", type=int, default=24_000)
+    p.add_argument("--reset_adam", type=int, default=0)
+    p.add_argument("--load_checkpoint", type=int, default=1)
+    p.add_argument("--retrain_times", type=int, default=4)
+    p.add_argument("--sort_test_case", type=int, default=0,
+                   help="1: pick the least-supported test points")
+    # framework knobs
+    p.add_argument("--backend", type=str, default=None,
+                   choices=[None, "tpu", "cpu"],
+                   help="force a JAX platform (default: auto)")
+    p.add_argument("--solver", type=str, default="direct",
+                   choices=["direct", "cg", "lissa"])
+    p.add_argument("--data_dir", type=str, default="data")
+    p.add_argument("--train_dir", type=str, default="output")
+    p.add_argument("--batch_size", type=int, default=0,
+                   help="0 = reference default for the dataset")
+    p.add_argument("--seed", type=int, default=0)
+    # synthetic scale (used when --dataset synthetic)
+    p.add_argument("--synth_users", type=int, default=600)
+    p.add_argument("--synth_items", type=int, default=400)
+    p.add_argument("--synth_train", type=int, default=50_000)
+    p.add_argument("--synth_test", type=int, default=500)
+    return p
+
+
+def apply_backend(args) -> None:
+    if args.backend == "cpu":
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    elif args.backend == "tpu":
+        os.environ.setdefault("JAX_PLATFORMS", "tpu")
+
+
+def load_splits(args):
+    if args.dataset == "synthetic":
+        return synthetic_splits(
+            args.synth_users, args.synth_items, args.synth_train,
+            args.synth_test, seed=args.seed,
+        )
+    return load_dataset(args.dataset, args.data_dir, synthesize_train=True,
+                        synth_seed=args.seed)
+
+
+def batch_size_for(args, train) -> int:
+    if args.batch_size:
+        return args.batch_size
+    if args.dataset in BATCH_SIZES:
+        return BATCH_SIZES[args.dataset]
+    return max(1, min(3000, train.num_examples // 10))
+
+
+def model_name_for(args, wd=None) -> str:
+    wd = args.weight_decay if wd is None else wd
+    return (
+        f"{args.dataset}_{args.model}_explicit_damping{args.damping:.0e}"
+        f"_avextol{args.avextol:.0e}_embed{args.embed_size}"
+        f"_maxinf{args.maxinf}_wd{wd:.0e}"
+    )
+
+
+def build_model(args, splits):
+    import jax
+
+    train = splits["train"]
+    num_users = max(int(np.max(s.x[:, 0])) + 1 for s in splits.values())
+    num_items = max(int(np.max(s.x[:, 1])) + 1 for s in splits.values())
+    model = MODELS[args.model](
+        num_users=num_users, num_items=num_items,
+        embedding_size=args.embed_size, weight_decay=args.weight_decay,
+    )
+    params = model.init_params(jax.random.PRNGKey(args.seed))
+    return model, params
+
+
+def train_or_load(args, model, params, splits, num_steps=None, verbose=True):
+    """Reference RQ2.py:102-109 train-or-load behavior."""
+    num_steps = num_steps or args.num_steps_train
+    train = splits["train"]
+    batch = batch_size_for(args, train)
+    cfg = TrainConfig(batch_size=batch, num_steps=num_steps,
+                      learning_rate=args.lr, seed=args.seed,
+                      log_every=10_000 if verbose else 0)
+    trainer = Trainer(model, cfg)
+    state = trainer.init_state(params)
+
+    ckpt = os.path.join(args.train_dir, f"{model_name_for(args)}-checkpoint-{num_steps - 1}")
+    if args.load_checkpoint and checkpoint.exists(ckpt):
+        print(f"Checkpoint found, loading {ckpt}")
+        p, o, step = checkpoint.load(ckpt, state.params, state.opt_state)
+        from fia_tpu.train.trainer import TrainState
+        state = TrainState(p, o if o is not None else state.opt_state, step)
+    else:
+        if verbose:
+            print(f"Training {args.model} for {num_steps} steps (batch {batch})")
+        state = trainer.fit(state, train.x, train.y)
+        os.makedirs(args.train_dir, exist_ok=True)
+        checkpoint.save(ckpt, state.params, state.opt_state, state.step)
+        if verbose:
+            print(f"Saved checkpoint {ckpt}")
+    return trainer, state, batch
+
+
+def pick_test_points(args, splits, engine_index):
+    """Random test points, or the least-supported ones when
+    sort_test_case=1 (reference RQ1.py:130-137)."""
+    test = splits["test"]
+    rng = np.random.default_rng(args.seed)
+    if args.sort_test_case:
+        counts = np.array(
+            [engine_index.related_count(int(u), int(i)) for u, i in test.x]
+        )
+        return np.argsort(counts)[: args.num_test]
+    return rng.choice(test.num_examples, size=args.num_test, replace=False)

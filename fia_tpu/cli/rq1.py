@@ -1,0 +1,79 @@
+"""RQ1 driver: influence-vs-retraining fidelity.
+
+Equivalent of reference ``src/scripts/RQ1.py`` (+ ``RQ1.sh``), with the
+argparse flags actually wired up. Outputs the same artifact fields —
+actual_loss_diffs, predicted_loss_diffs, indices_to_remove — as
+``output/RQ1-<model>-<dataset>.npz`` and prints the Pearson correlation.
+
+Run:  python -m fia_tpu.cli.rq1 --dataset synthetic --model MF \
+        --num_steps_train 3000 --num_steps_retrain 1500 --num_test 2
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from fia_tpu.cli import common
+
+
+def main(argv=None):
+    args = common.base_parser(__doc__).parse_args(argv)
+    common.apply_backend(args)
+
+    from fia_tpu.eval.metrics import pearson, spearman
+    from fia_tpu.eval.rq1 import test_retraining
+    from fia_tpu.influence.engine import InfluenceEngine
+
+    splits = common.load_splits(args)
+    train, test = splits["train"], splits["test"]
+    model, params = common.build_model(args, splits)
+    print(f"users={model.num_users} items={model.num_items} "
+          f"train={train.num_examples} test={test.num_examples} "
+          f"params={model.num_params()}")
+
+    trainer, state, batch = common.train_or_load(args, model, params, splits)
+
+    engine = InfluenceEngine(
+        model, state.params, train,
+        damping=args.damping, solver=args.solver, cg_tol=args.avextol * 1e-6,
+        cache_dir=args.train_dir, model_name=common.model_name_for(args),
+    )
+    test_indices = common.pick_test_points(args, splits, engine.index)
+    print(f"test indices: {list(map(int, test_indices))}")
+
+    actuals, predictions = [], []
+    num_to_remove = min(50, args.num_test and 50)
+    for t in test_indices:
+        res = test_retraining(
+            engine, train, test, int(t),
+            num_to_remove=num_to_remove,
+            num_steps=args.num_steps_retrain,
+            batch_size=batch,
+            learning_rate=args.lr,
+            retrain_times=args.retrain_times,
+            remove_type="maxinf" if args.maxinf else "random",
+        )
+        r = pearson(res.actual_y_diffs, res.predicted_y_diffs)
+        print(f"test {int(t)}: pearson r = {r:.4f} "
+              f"(bias_retrain {res.bias_retrain:+.5f})")
+        actuals.append(res.actual_y_diffs)
+        predictions.append(res.predicted_y_diffs)
+
+        os.makedirs(args.train_dir, exist_ok=True)
+        np.savez(
+            os.path.join(args.train_dir, f"RQ1-{args.model}-{args.dataset}.npz"),
+            actual_loss_diffs=np.array(actuals),
+            predicted_loss_diffs=np.array(predictions),
+            indices_to_remove=res.indices_to_remove,
+        )
+
+    a = np.concatenate(actuals)
+    p = np.concatenate(predictions)
+    print(f"Correlation is {pearson(a, p):.6f} (spearman {spearman(a, p):.6f})")
+    return pearson(a, p)
+
+
+if __name__ == "__main__":
+    main()

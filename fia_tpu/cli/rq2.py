@@ -1,0 +1,57 @@
+"""RQ2 driver: wall-clock cost of influence queries.
+
+Equivalent of reference ``src/scripts/RQ2.py`` + ``RQ2.sh`` (the
+embed-size sweep that the reference's inert argparse silently dropped
+works here). Prints the reference's timer lines plus a JSON summary with
+throughput numbers.
+
+Run:  python -m fia_tpu.cli.rq2 --dataset synthetic --model MF \
+        --num_steps_train 2000 --num_test 64
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from fia_tpu.cli import common
+
+
+def main(argv=None):
+    args = common.base_parser(__doc__).parse_args(argv)
+    common.apply_backend(args)
+
+    from fia_tpu.eval.rq2 import time_influence_queries
+    from fia_tpu.influence.engine import InfluenceEngine
+
+    splits = common.load_splits(args)
+    train, test = splits["train"], splits["test"]
+    model, params = common.build_model(args, splits)
+    trainer, state, batch = common.train_or_load(args, model, params, splits)
+
+    engine = InfluenceEngine(
+        model, state.params, train,
+        damping=args.damping, solver=args.solver,
+        cache_dir=args.train_dir, model_name=common.model_name_for(args),
+    )
+
+    rng = np.random.default_rng(args.seed + 17)
+    n_queries = max(args.num_test, 1)
+    test_idx = rng.choice(test.num_examples, size=n_queries, replace=False)
+    points = test.x[test_idx]
+
+    timing = time_influence_queries(engine, points)
+    # reference-format lines (matrix_factorization.py:225, 249-250)
+    print(f"Inverse HVP + scoring for {timing.num_queries} queries took "
+          f"{timing.total_time_s} sec")
+    print(f"Multiplying by {timing.num_scores} train examples took "
+          f"{timing.total_time_s} sec (fused)")
+    print(f"Total time is {timing.total_time_s} sec")
+    print(json.dumps({"model": args.model, "dataset": args.dataset,
+                      "embed_size": args.embed_size, **timing.json()}))
+    return timing
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,3 @@
+from fia_tpu.eval.metrics import pearson, spearman  # noqa: F401
+from fia_tpu.eval.rq1 import test_retraining, RetrainResult  # noqa: F401
+from fia_tpu.eval.rq2 import time_influence_queries, TimingResult  # noqa: F401

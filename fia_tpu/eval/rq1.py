@@ -1,0 +1,113 @@
+"""RQ1: influence-vs-retraining fidelity.
+
+Parity target: reference ``src/influence/experiments.py:17-150``
+(``test_retraining``) driven by ``src/scripts/RQ1.py:142-165`` — for one
+test interaction, predict the rating change from removing each selected
+training row via influence, then measure the actual change by
+leave-one-out retraining, and correlate.
+
+TPU-native shape: the reference retrains sequentially (num_to_remove ×
+retrain_times full training runs). Here every (removed row, repeat) pair
+is one vmap lane of a single compiled retraining program, including the
+no-removal drift-bias lanes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fia_tpu.data.dataset import RatingDataset
+from fia_tpu.influence.engine import InfluenceEngine
+from fia_tpu.train.trainer import loo_retrain_many
+
+
+@dataclass
+class RetrainResult:
+    actual_y_diffs: np.ndarray  # (R,) retraining ground truth
+    predicted_y_diffs: np.ndarray  # (R,) influence predictions
+    indices_to_remove: np.ndarray  # (R,) positions into the related set
+    removed_train_rows: np.ndarray  # (R,) train-row ids
+    bias_retrain: float  # no-removal drift (subtracted from actuals)
+
+
+def test_retraining(
+    engine: InfluenceEngine,
+    train: RatingDataset,
+    test_ds: RatingDataset,
+    test_idx: int,
+    num_to_remove: int = 50,
+    num_steps: int = 1000,
+    batch_size: int = 100,
+    learning_rate: float = 1e-3,
+    retrain_times: int = 4,
+    remove_type: str = "maxinf",
+    random_seed: int = 17,
+    clamp: float = 1.0,
+) -> RetrainResult:
+    """Run the RQ1 experiment for one test point.
+
+    remove_type: 'maxinf' picks the |influence|-largest related rows
+    (reference ``experiments.py:36-48``); 'random' samples uniformly from
+    the related set.
+    """
+    model = engine.model
+    params0 = engine.params
+    rng = np.random.default_rng(random_seed)
+
+    point = test_ds.x[test_idx]
+    res = engine.query_batch(point[None, :])
+    scores = res.scores_of(0)
+    related = res.related_of(0)
+
+    if remove_type == "maxinf":
+        sel = np.argsort(np.abs(scores))[-num_to_remove:][::-1].copy()
+    elif remove_type == "random":
+        sel = rng.choice(len(related), size=min(num_to_remove, len(related)),
+                         replace=False)
+    else:
+        raise ValueError(f"remove_type {remove_type!r} not well specified")
+
+    predicted = scores[sel]
+    removed_rows = related[sel]
+
+    # Original prediction on the test point.
+    tx = jnp.asarray(point[None, :])
+    y0 = float(model.predict(params0, tx)[0])
+
+    # One vmapped program: (num_to_remove + 1) removal lanes x retrain_times
+    # repeats; lane -1 removes nothing and measures retraining drift.
+    lanes = np.concatenate([removed_rows, [-1]])
+    all_removed = np.repeat(lanes, retrain_times)
+    all_seeds = np.tile(
+        random_seed + np.arange(retrain_times), len(lanes)
+    ).astype(np.uint32)
+
+    params_stack = loo_retrain_many(
+        model, params0, train.x, train.y, all_removed,
+        num_steps=num_steps, batch_size=batch_size,
+        learning_rate=learning_rate, seeds=all_seeds,
+    )
+    preds = jax.jit(jax.vmap(lambda p: model.predict(p, tx)[0]))(params_stack)
+    preds = np.asarray(preds).reshape(len(lanes), retrain_times)
+
+    # NaN-robust means (reference drops NaN retrain outcomes,
+    # experiments.py:136-137).
+    with np.errstate(invalid="ignore"):
+        lane_means = np.nanmean(preds, axis=1)
+    bias = float(lane_means[-1] - y0)
+    actual = lane_means[:-1] - y0 - bias
+
+    # |predicted| > clamp is zeroed (reference experiments.py:139-140).
+    predicted = np.where(np.abs(predicted) > clamp, 0.0, predicted)
+
+    return RetrainResult(
+        actual_y_diffs=np.asarray(actual),
+        predicted_y_diffs=np.asarray(predicted),
+        indices_to_remove=np.asarray(sel),
+        removed_train_rows=np.asarray(removed_rows),
+        bias_retrain=bias,
+    )

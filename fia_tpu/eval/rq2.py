@@ -1,0 +1,93 @@
+"""RQ2: wall-clock cost of influence queries.
+
+Parity target: reference ``src/scripts/RQ2.py`` + ``experiments.py:4-15``
+(``record_time_cost``): time one influence query — inverse-HVP solve plus
+scoring every related training row. The reference's printed timers ARE
+its benchmark output (``matrix_factorization.py:225, 249-250``).
+
+Here timing uses ``block_until_ready`` fences, separates compile from
+steady state, and reports throughput (queries/sec and scores/sec, the
+BASELINE.json primary metric) over a batch of test points.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+import jax
+import numpy as np
+
+from fia_tpu.influence.engine import InfluenceEngine
+
+
+@dataclass
+class TimingResult:
+    num_queries: int
+    num_scores: int  # total related rows scored
+    compile_time_s: float
+    total_time_s: float  # steady-state wall clock (excl. compile)
+    queries_per_sec: float
+    scores_per_sec: float
+    per_query_ms: float
+    repeats: int = 1
+    times_s: list = field(default_factory=list)
+
+    def json(self) -> dict:
+        return {
+            "num_queries": self.num_queries,
+            "num_scores": self.num_scores,
+            "compile_time_s": round(self.compile_time_s, 4),
+            "total_time_s": round(self.total_time_s, 4),
+            "queries_per_sec": round(self.queries_per_sec, 2),
+            "scores_per_sec": round(self.scores_per_sec, 2),
+            "per_query_ms": round(self.per_query_ms, 4),
+        }
+
+
+def time_influence_queries(
+    engine: InfluenceEngine,
+    test_points: np.ndarray,
+    repeats: int = 3,
+    pad_to: int | None = None,
+) -> TimingResult:
+    """Time batched influence queries over ``test_points`` (T, 2).
+
+    The first call (compile + run) is measured separately; steady-state
+    time is the best of ``repeats`` fenced runs, matching standard JAX
+    benchmarking practice.
+    """
+    test_points = np.asarray(test_points)
+    if pad_to is None:
+        _, _, counts = engine.index.related_padded(
+            test_points, bucket=engine.pad_bucket
+        )
+        m = int(counts.max())
+        pad_to = max(
+            engine.pad_bucket,
+            -(-m // engine.pad_bucket) * engine.pad_bucket,
+        )
+
+    t0 = time.perf_counter()
+    res = engine.query_batch(test_points, pad_to=pad_to)
+    compile_time = time.perf_counter() - t0
+
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        res = engine.query_batch(test_points, pad_to=pad_to)
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+
+    num_scores = int(res.counts.sum())
+    return TimingResult(
+        num_queries=len(test_points),
+        num_scores=num_scores,
+        compile_time_s=compile_time,
+        total_time_s=best,
+        queries_per_sec=len(test_points) / best,
+        scores_per_sec=num_scores / best,
+        per_query_ms=1e3 * best / len(test_points),
+        repeats=repeats,
+        times_s=times,
+    )

@@ -28,9 +28,13 @@ from jax import lax
 
 
 def solve_direct(H: jnp.ndarray, v: jnp.ndarray) -> jnp.ndarray:
-    """Solve H x = v for dense PSD H via Cholesky."""
-    cho = jax.scipy.linalg.cho_factor(H)
-    return jax.scipy.linalg.cho_solve(cho, v)
+    """Solve H x = v for dense H via LU.
+
+    LU rather than Cholesky: at a well-trained optimum the damped block
+    Hessian is PD, but away from it the MSE Hessian's second-order term
+    can make H indefinite (Cholesky would silently produce NaNs).
+    """
+    return jnp.linalg.solve(H, v)
 
 
 def solve_cg(
@@ -57,13 +61,23 @@ def solve_cg(
         return jnp.logical_and(rs > threshold, it < maxiter)
 
     def body(state):
+        # Under vmap the loop keeps running until ALL lanes converge, so
+        # converged lanes must freeze (their p·Hp -> 0 would give 0/0).
+        # A lane hitting negative curvature (H not PD away from an
+        # optimum) also freezes, Newton-CG style: keep the current x.
         x, r, p, rs, it = state
         hp = hvp(p)
-        alpha = rs / jnp.vdot(p, hp)
+        denom = jnp.vdot(p, hp)
+        stop = jnp.logical_or(rs <= threshold, denom <= 0.0)
+        alpha = jnp.where(stop, 0.0, rs / jnp.where(denom != 0.0, denom, 1.0))
         x = x + alpha * p
         r = r - alpha * hp
-        rs_new = jnp.vdot(r, r)
-        p = r + (rs_new / rs) * p
+        rs_new = jnp.where(stop, rs, jnp.vdot(r, r))
+        beta = jnp.where(stop, 0.0, rs_new / jnp.where(rs != 0.0, rs, 1.0))
+        p = jnp.where(stop, p, r + beta * p)
+        # force the loop to exit for frozen lanes by zeroing their rs
+        rs_new = jnp.where(jnp.logical_and(denom <= 0.0, rs > threshold),
+                           jnp.zeros_like(rs_new), rs_new)
         return x, r, p, rs_new, it + 1
 
     x, *_ = lax.while_loop(cond, body, (x, r, p, rs, jnp.int32(0)))

@@ -40,6 +40,12 @@ class LatentFactorModel:
     #: ``genericNeuralNet.py:40-65``: wd * l2_loss = wd * 0.5 * sum(w^2)).
     decayed: tuple[str, ...] = ()
 
+    #: flattening order of the FIA block — fixed explicitly so the flat
+    #: inverse-HVP layout matches the reference's params_test order
+    #: (e.g. [p_u, q_i, b_u, b_i] for MF, matrix_factorization.py:38-67)
+    #: instead of the dict pytree's alphabetical order.
+    block_keys: tuple[str, ...] = ()
+
     def __init__(self, num_users: int, num_items: int, embedding_size: int,
                  weight_decay: float):
         self.num_users = int(num_users)
@@ -122,14 +128,17 @@ class LatentFactorModel:
         return self.loss(self.with_block(params, block, u, i), x, y, w)
 
     def flatten_block(self, block: Block) -> jnp.ndarray:
-        leaves = jax.tree_util.tree_leaves(block)
-        return jnp.concatenate([jnp.ravel(l) for l in leaves])
+        keys = self.block_keys or tuple(sorted(block))
+        return jnp.concatenate(
+            [jnp.ravel(jnp.asarray(block[k])) for k in keys]
+        )
 
     def unflatten_block(self, vec: jnp.ndarray, like: Block) -> Block:
-        leaves, treedef = jax.tree_util.tree_flatten(like)
-        out, pos = [], 0
-        for l in leaves:
+        keys = self.block_keys or tuple(sorted(like))
+        out, pos = {}, 0
+        for k in keys:
+            l = jnp.asarray(like[k])
             n = math.prod(l.shape)
-            out.append(jnp.reshape(vec[pos : pos + n], l.shape))
+            out[k] = jnp.reshape(vec[pos : pos + n], l.shape)
             pos += n
-        return jax.tree_util.tree_unflatten(treedef, out)
+        return out

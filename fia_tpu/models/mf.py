@@ -22,6 +22,7 @@ from fia_tpu.models.base import LatentFactorModel, truncated_normal
 
 class MF(LatentFactorModel):
     decayed = ("P", "Q")
+    block_keys = ("pu", "qi", "bu", "bi")
 
     def init_params(self, key):
         k = self.embedding_size

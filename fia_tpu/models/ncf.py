@@ -23,6 +23,7 @@ from fia_tpu.models.base import LatentFactorModel, truncated_normal
 
 class NCF(LatentFactorModel):
     decayed = ("P_mlp", "Q_mlp", "P_gmf", "Q_gmf", "W1", "W2", "W3")
+    block_keys = ("pu_mlp", "qi_mlp", "pu_gmf", "qi_gmf")
 
     def init_params(self, key):
         k = self.embedding_size

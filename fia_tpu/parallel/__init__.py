@@ -1,0 +1,1 @@
+from fia_tpu.parallel.mesh import make_mesh, shard_along, replicate  # noqa: F401

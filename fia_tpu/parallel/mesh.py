@@ -1,0 +1,52 @@
+"""Device-mesh helpers.
+
+The reference has no distributed machinery at all (single tf.Session,
+pinned device — SURVEY.md §2.4). The TPU-native scaling axes for this
+workload are:
+
+  - ``data``: test-query batches (influence) and train-row shards (full
+    HVP accumulation) — collectives ride ICI via XLA-inserted psums.
+  - ``model``: optional row-sharding of the user/item embedding tables
+    for the scaled stress configs.
+
+All entry points accept an optional Mesh; everything degrades to single
+device when the mesh is None or trivial.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(
+    n_devices: int | None = None,
+    axis_names: tuple[str, ...] = ("data",),
+    shape: tuple[int, ...] | None = None,
+) -> Mesh:
+    """Build a Mesh over the first n (default: all) local devices."""
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    if shape is None:
+        shape = (len(devs),) + (1,) * (len(axis_names) - 1)
+    arr = np.asarray(devs).reshape(shape)
+    return Mesh(arr, axis_names)
+
+
+def shard_along(mesh: Mesh, tree, axis: str = "data", dim: int = 0):
+    """Shard every leaf's ``dim`` dimension along a mesh axis."""
+
+    def put(x):
+        spec = [None] * x.ndim
+        spec[dim] = axis
+        return jax.device_put(x, NamedSharding(mesh, P(*spec)))
+
+    return jax.tree_util.tree_map(put, tree)
+
+
+def replicate(mesh: Mesh, tree):
+    sharding = NamedSharding(mesh, P())
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), tree)

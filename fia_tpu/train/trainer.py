@@ -92,7 +92,9 @@ class Trainer:
             )
             return params, opt_state, losses
 
-        return jax.jit(epoch, donate_argnums=(0, 1))
+        # no buffer donation: callers (retraining, tests) legitimately
+        # reuse the pre-training params after fit() returns
+        return jax.jit(epoch)
 
     def _make_full_fn(self, use_sgd: bool):
         model = self.model
@@ -111,7 +113,7 @@ class Trainer:
             )
             return params, opt_state, losses
 
-        return jax.jit(run, static_argnums=(5,), donate_argnums=(0, 1))
+        return jax.jit(run, static_argnums=(5,))
 
     # -- public API --------------------------------------------------------
     def fit(
@@ -180,14 +182,13 @@ class Trainer:
 def loo_retrain_many(
     model,
     params0,
-    opt_template,
     x,
     y,
     removed_indices,
     num_steps: int,
     batch_size: int,
     learning_rate: float = 1e-3,
-    seed: int = 17,
+    seeds=None,
 ):
     """Leave-one-out retraining, vmapped over removed points.
 
@@ -195,8 +196,11 @@ def loo_retrain_many(
     training row (reference ``experiments.py:109-133``, strictly
     sequential). Here all R retrains run simultaneously as one vmapped
     program: each lane masks its removed row out of the loss via a weight
-    vector, every lane shares the same batch schedule. Returns the (R,)
-    pytree-stack of retrained params.
+    vector. A removed index of -1 removes nothing (used for the
+    retraining-drift bias lane, reference ``experiments.py:94-106``).
+    ``seeds`` (R,) varies the batch shuffle per lane; lanes with equal
+    seeds share a schedule. Returns the (R,) pytree-stack of retrained
+    params.
     """
     x = jnp.asarray(x)
     y = jnp.asarray(y)
@@ -204,9 +208,15 @@ def loo_retrain_many(
     nb = n // batch_size
     opt = optax.adam(learning_rate)
     removed = jnp.asarray(removed_indices, jnp.int32)
+    if seeds is None:
+        seeds = jnp.full(removed.shape, 17, jnp.uint32)
+    else:
+        seeds = jnp.asarray(seeds, jnp.uint32)
 
-    def retrain_one(ridx):
-        w = jnp.ones((n,), jnp.float32).at[ridx].set(0.0)
+    def retrain_one(ridx, seed):
+        w = jnp.ones((n,), jnp.float32).at[
+            jnp.clip(ridx, 0, n - 1)
+        ].set(jnp.where(ridx >= 0, 0.0, 1.0))
         opt_state = opt.init(params0)
 
         def epoch(carry, ekey):
@@ -240,4 +250,4 @@ def loo_retrain_many(
         )
         return params
 
-    return jax.jit(jax.vmap(retrain_one))(removed)
+    return jax.jit(jax.vmap(retrain_one))(removed, seeds)

@@ -1,0 +1,1 @@
+from fia_tpu.utils.timing import Timer, fenced_time  # noqa: F401

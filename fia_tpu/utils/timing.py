@@ -1,0 +1,51 @@
+"""Timing helpers.
+
+The reference benchmarks with bare ``time.time()`` prints around
+``sess.run`` calls (``matrix_factorization.py:216-250``). On an async
+dispatch runtime that under-measures; these helpers fence with
+``block_until_ready`` and can emit a ``jax.profiler`` trace.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+import jax
+
+
+def fenced_time(fn, *args, **kwargs):
+    """(result, seconds) with a device fence after fn."""
+    t0 = time.perf_counter()
+    out = fn(*args, **kwargs)
+    out = jax.block_until_ready(out)
+    return out, time.perf_counter() - t0
+
+
+class Timer:
+    """Named section timer: ``with timer('solve'): ...``; .report() dict."""
+
+    def __init__(self):
+        self.sections: dict[str, float] = {}
+
+    @contextlib.contextmanager
+    def __call__(self, name: str, fence: bool = False):
+        t0 = time.perf_counter()
+        yield
+        if fence:
+            # fence everything outstanding on the default backend
+            jax.effects_barrier()
+        self.sections[name] = self.sections.get(name, 0.0) + time.perf_counter() - t0
+
+    def report(self) -> dict[str, float]:
+        return dict(self.sections)
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: str | None):
+    """Optionally wrap a block in a jax.profiler trace."""
+    if log_dir:
+        with jax.profiler.trace(log_dir):
+            yield
+    else:
+        yield

@@ -7,10 +7,19 @@ Multi-device sharding tests run against these virtual devices (SURVEY.md
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force, don't setdefault: the driver environment presets JAX_PLATFORMS
+# to the tunneled TPU, and unit tests must not contend for the one chip.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The axon sitecustomize (tunneled-TPU image) re-selects its platform via
+# jax.config at interpreter start, which overrides the env var — force the
+# config back to CPU before any backend initialises.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

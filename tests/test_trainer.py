@@ -1,0 +1,126 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fia_tpu.models import MF
+from fia_tpu.train.trainer import Trainer, TrainConfig, loo_retrain_many
+from fia_tpu.train import checkpoint
+
+
+def _model_and_data(tiny_splits):
+    train = tiny_splits["train"]
+    model = MF(train.num_users, train.num_items, 4, 1e-3)
+    params = model.init_params(jax.random.PRNGKey(0))
+    return model, params, train
+
+
+class TestTrainer:
+    def test_loss_decreases(self, tiny_splits):
+        model, params, train = _model_and_data(tiny_splits)
+        cfg = TrainConfig(batch_size=200, num_steps=300, learning_rate=1e-2)
+        tr = Trainer(model, cfg)
+        s0 = tr.init_state(params)
+        before = float(model.loss(params, jnp.asarray(train.x), jnp.asarray(train.y)))
+        s1 = tr.fit(s0, train.x, train.y)
+        after = float(model.loss(s1.params, jnp.asarray(train.x), jnp.asarray(train.y)))
+        assert after < before * 0.8
+        assert s1.step == 300
+
+    def test_partial_epoch_limit(self, tiny_splits):
+        """Steps that don't fill an epoch must not apply extra updates."""
+        model, params, train = _model_and_data(tiny_splits)
+        cfg = TrainConfig(batch_size=200, num_steps=3, learning_rate=1e-2)
+        tr = Trainer(model, cfg)
+        s1 = tr.fit(tr.init_state(params), train.x, train.y)
+        # 3 steps of adam(1e-2): params move, but only slightly
+        delta = jnp.abs(s1.params["P"] - params["P"]).max()
+        assert 0 < float(delta) <= 3 * 1e-2 * 1.05  # ~lr per Adam step
+
+    def test_masked_row_has_no_effect(self, tiny_splits):
+        """Training with w[j]=0 equals training without row j when the
+        batch schedule is identical (single-batch case)."""
+        model, params, train = _model_and_data(tiny_splits)
+        n = 100
+        x, y = train.x[:n], train.y[:n]
+        cfg = TrainConfig(batch_size=n, num_steps=20, learning_rate=1e-2)
+        tr = Trainer(model, cfg)
+        w = np.ones(n, np.float32)
+        w[7] = 0.0
+        s_masked = tr.fit(tr.init_state(params), x, y, weights=w)
+
+        # same semantics via full-batch loss on 99 rows is not directly
+        # comparable batch-wise; instead verify the masked row's gradient
+        # truly vanished: perturbing its label changes nothing.
+        y2 = y.copy()
+        y2[7] = 1.0 if y[7] > 3 else 5.0
+        s_masked2 = tr.fit(tr.init_state(params), x, y2, weights=w)
+        for a, b in zip(jax.tree_util.tree_leaves(s_masked.params),
+                        jax.tree_util.tree_leaves(s_masked2.params)):
+            np.testing.assert_allclose(a, b, atol=1e-7)
+
+    def test_phase_switches_run(self, tiny_splits):
+        model, params, train = _model_and_data(tiny_splits)
+        cfg = TrainConfig(batch_size=200, num_steps=30, learning_rate=1e-3,
+                          iter_to_switch_to_batch=10, iter_to_switch_to_sgd=20)
+        tr = Trainer(model, cfg)
+        s1 = tr.fit(tr.init_state(params), train.x, train.y)
+        assert s1.step == 30
+        assert all(jnp.isfinite(l).all() for l in jax.tree_util.tree_leaves(s1.params))
+
+    def test_reset_optimizer(self, tiny_splits):
+        model, params, train = _model_and_data(tiny_splits)
+        tr = Trainer(model, TrainConfig(batch_size=200, num_steps=50))
+        s1 = tr.fit(tr.init_state(params), train.x, train.y)
+        s2 = tr.reset_optimizer(s1)
+        fresh = tr.optimizer.init(s1.params)
+        for a, b in zip(jax.tree_util.tree_leaves(s2.opt_state),
+                        jax.tree_util.tree_leaves(fresh)):
+            np.testing.assert_allclose(a, b)
+
+
+class TestLooRetrain:
+    def test_lanes_differ_and_sentinel(self, tiny_splits):
+        model, params, train = _model_and_data(tiny_splits)
+        removed = np.array([0, 5, -1])
+        stack = loo_retrain_many(
+            model, params, train.x, train.y, removed,
+            num_steps=40, batch_size=200, learning_rate=1e-2,
+        )
+        p = stack["P"]
+        assert p.shape[0] == 3
+        # all lanes trained (differ from init)
+        assert float(jnp.abs(p[0] - params["P"]).max()) > 1e-4
+        # removing different rows gives different results
+        assert float(jnp.abs(p[0] - p[1]).max()) > 1e-7
+
+    def test_seed_controls_schedule(self, tiny_splits):
+        model, params, train = _model_and_data(tiny_splits)
+        stack = loo_retrain_many(
+            model, params, train.x, train.y, np.array([-1, -1]),
+            num_steps=40, batch_size=200, learning_rate=1e-2,
+            seeds=np.array([1, 2], np.uint32),
+        )
+        assert float(jnp.abs(stack["P"][0] - stack["P"][1]).max()) > 1e-7
+
+
+class TestCheckpoint:
+    def test_roundtrip(self, tiny_splits, tmp_path):
+        model, params, train = _model_and_data(tiny_splits)
+        tr = Trainer(model, TrainConfig(batch_size=200, num_steps=10))
+        s = tr.fit(tr.init_state(params), train.x, train.y)
+        path = checkpoint.save(str(tmp_path / "ck"), s.params, s.opt_state, s.step)
+        p2, o2, step = checkpoint.load(path, s.params, s.opt_state)
+        assert step == 10
+        for a, b in zip(jax.tree_util.tree_leaves(s.params),
+                        jax.tree_util.tree_leaves(p2)):
+            np.testing.assert_allclose(a, b)
+        for a, b in zip(jax.tree_util.tree_leaves(s.opt_state),
+                        jax.tree_util.tree_leaves(o2)):
+            np.testing.assert_allclose(a, b)
+
+    def test_structure_mismatch_raises(self, tmp_path):
+        import pytest
+
+        path = checkpoint.save(str(tmp_path / "ck"), {"a": np.ones(3)})
+        with pytest.raises(ValueError):
+            checkpoint.load(path, {"b": {"c": np.ones(3)}})
