@@ -16,6 +16,7 @@ Spearman rank-correlation parity between the two engines' scores.
 
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -26,7 +27,33 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 QUICK = "--quick" in sys.argv
 
 
+def _ensure_live_backend(timeout_s: int = 180) -> None:
+    """Probe the default JAX backend in a subprocess; if it cannot
+    initialise (e.g. the TPU tunnel is down), fall back to CPU rather
+    than hanging the benchmark forever."""
+    probe = (
+        "import jax; jax.devices(); import jax.numpy as jnp; "
+        "jnp.ones(()).block_until_ready(); print(jax.default_backend())"
+    )
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", probe], capture_output=True,
+            timeout=timeout_s,
+        )
+        if out.returncode == 0:
+            return
+    except subprocess.TimeoutExpired:
+        pass
+    print("bench: default backend unreachable; falling back to CPU",
+          file=sys.stderr)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
 def main():
+    _ensure_live_backend()
     import jax
 
     from fia_tpu.backends.torch_ref import TorchRefMFEngine

@@ -7,7 +7,7 @@ import numpy as np
 import pytest
 
 from fia_tpu.eval.metrics import pearson, spearman
-from fia_tpu.eval.rq1 import test_retraining
+from fia_tpu.eval.rq1 import test_retraining as run_retraining
 from fia_tpu.eval.rq2 import time_influence_queries
 from fia_tpu.influence.engine import InfluenceEngine
 from fia_tpu.models import MF
@@ -42,7 +42,7 @@ class TestEndToEnd:
         test = tiny_splits["test"]
         engine = InfluenceEngine(model, state.params, train, damping=1e-4)
 
-        res = test_retraining(
+        res = run_retraining(
             engine, train, test, test_idx=0,
             num_to_remove=12, num_steps=800, batch_size=200,
             learning_rate=1e-2, retrain_times=2,
