@@ -15,14 +15,15 @@ from __future__ import annotations
 
 import numpy as np
 
+from fia_tpu.data import native
+
 
 def _csr_from_ids(ids: np.ndarray, num_groups: int):
-    """Group row positions by id. Returns (indptr, indices) CSR arrays."""
-    order = np.argsort(ids, kind="stable")
-    counts = np.bincount(ids, minlength=num_groups)
-    indptr = np.zeros(num_groups + 1, dtype=np.int64)
-    np.cumsum(counts, out=indptr[1:])
-    return indptr, order.astype(np.int64)
+    """Group row positions by id. Returns (indptr, indices) CSR arrays.
+
+    Uses the native counting-sort builder when libfia_native is
+    available; numpy stable argsort otherwise (identical output)."""
+    return native.build_csr(ids, num_groups)
 
 
 class InteractionIndex:

@@ -17,6 +17,7 @@ import os
 
 import numpy as np
 
+from fia_tpu.data import native
 from fia_tpu.data.dataset import RatingDataset
 from fia_tpu.data.synthetic import synthesize_ratings
 
@@ -34,12 +35,8 @@ _SPECS = {
 
 
 def _read_tsv(path: str, n_rows: int | None) -> RatingDataset:
-    raw = np.loadtxt(path, dtype=np.float64)
-    if raw.ndim == 1:
-        raw = raw.reshape(1, -1)
-    if n_rows is not None:
-        raw = raw[:n_rows]
-    return RatingDataset(raw[:, :2].astype(np.int32), raw[:, 2].astype(np.float32))
+    users, items, ratings = native.parse_tsv(path, max_rows=n_rows)
+    return RatingDataset(np.stack([users, items], axis=1), ratings)
 
 
 def save_tsv(ds: RatingDataset, path: str) -> None:

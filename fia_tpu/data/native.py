@@ -1,0 +1,116 @@
+"""ctypes bindings for the native data-path library (native/fia_native.cpp).
+
+Provides a fast TSV rating parser and CSR index builder; every entry
+point has a numpy fallback so the framework runs without the shared
+library (set ``FIA_NATIVE=0`` to force the fallback). The library is
+built with ``make -C native`` and auto-built on first use when a
+compiler is available.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_SO_PATH = os.path.join(_REPO_ROOT, "native", "libfia_native.so")
+
+_lib = None
+_tried = False
+
+
+def _load():
+    global _lib, _tried
+    if _tried:
+        return _lib
+    _tried = True
+    if os.environ.get("FIA_NATIVE", "1") == "0":
+        return None
+    if not os.path.exists(_SO_PATH):
+        try:
+            subprocess.run(
+                ["make", "-C", os.path.join(_REPO_ROOT, "native")],
+                capture_output=True, timeout=120, check=True,
+            )
+        except Exception:
+            return None
+    try:
+        lib = ctypes.CDLL(_SO_PATH)
+        lib.fia_count_rows.restype = ctypes.c_int64
+        lib.fia_count_rows.argtypes = [ctypes.c_char_p]
+        lib.fia_parse_tsv.restype = ctypes.c_int64
+        lib.fia_parse_tsv.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_float),
+        ]
+        lib.fia_build_csr.restype = ctypes.c_int32
+        lib.fia_build_csr.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ]
+        _lib = lib
+    except OSError:
+        _lib = None
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def parse_tsv(path: str, max_rows: int | None = None):
+    """(users, items, ratings) arrays from a ratings TSV file."""
+    lib = _load()
+    if lib is None:
+        raw = np.loadtxt(path, dtype=np.float64)
+        if raw.ndim == 1:
+            raw = raw.reshape(1, -1)
+        if max_rows is not None:
+            raw = raw[:max_rows]
+        return (raw[:, 0].astype(np.int32), raw[:, 1].astype(np.int32),
+                raw[:, 2].astype(np.float32))
+
+    n = lib.fia_count_rows(path.encode())
+    if n < 0:
+        raise IOError(f"cannot read {path}")
+    if max_rows is not None:
+        n = min(n, max_rows)
+    users = np.empty(n, np.int32)
+    items = np.empty(n, np.int32)
+    ratings = np.empty(n, np.float32)
+    got = lib.fia_parse_tsv(
+        path.encode(), n,
+        users.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        items.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        ratings.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+    )
+    if got < 0:
+        raise IOError(f"cannot read {path}")
+    return users[:got], items[:got], ratings[:got]
+
+
+def build_csr(ids: np.ndarray, num_groups: int):
+    """(indptr, indices) grouping row positions by id; stable order."""
+    ids = np.ascontiguousarray(ids, np.int32)
+    lib = _load()
+    if lib is None:
+        order = np.argsort(ids, kind="stable").astype(np.int64)
+        counts = np.bincount(ids, minlength=num_groups)
+        indptr = np.zeros(num_groups + 1, np.int64)
+        np.cumsum(counts, out=indptr[1:])
+        return indptr, order
+    indptr = np.empty(num_groups + 1, np.int64)
+    indices = np.empty(len(ids), np.int64)
+    rc = lib.fia_build_csr(
+        ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        len(ids), num_groups,
+        indptr.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+    )
+    if rc != 0:
+        raise ValueError("id out of range in build_csr")
+    return indptr, indices

@@ -1,0 +1,124 @@
+// Native data-path kernels for fia_tpu.
+//
+// The reference's data layer is numpy `loadtxt` + linear scans (its repo has
+// no native code at all — SURVEY.md §2.4); at ML-20M-stress scale the host
+// data path becomes the bottleneck ahead of the TPU, so the TSV rating
+// parser and the CSR inverted-index builder are provided natively and
+// exposed through ctypes (fia_tpu/data/native.py), with pure-numpy
+// fallbacks when the shared library is absent.
+//
+// Build: make -C native   (produces libfia_native.so)
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+extern "C" {
+
+// Count data rows (non-empty lines) in a ratings TSV file.
+// Returns -1 on IO error.
+int64_t fia_count_rows(const char* path) {
+    FILE* f = std::fopen(path, "rb");
+    if (!f) return -1;
+    constexpr size_t BUF = 1 << 20;
+    char* buf = static_cast<char*>(std::malloc(BUF));
+    int64_t rows = 0;
+    bool line_has_data = false;
+    size_t got;
+    while ((got = std::fread(buf, 1, BUF, f)) > 0) {
+        for (size_t i = 0; i < got; ++i) {
+            char c = buf[i];
+            if (c == '\n') {
+                if (line_has_data) ++rows;
+                line_has_data = false;
+            } else if (c != '\r' && c != ' ' && c != '\t') {
+                line_has_data = true;
+            }
+        }
+    }
+    if (line_has_data) ++rows;
+    std::free(buf);
+    std::fclose(f);
+    return rows;
+}
+
+// Parse up to max_rows "user \t item \t rating" lines into preallocated
+// buffers. Returns the number of rows parsed, or -1 on IO error.
+// Whitespace-tolerant; ratings may be integers or decimals.
+int64_t fia_parse_tsv(const char* path, int64_t max_rows,
+                      int32_t* users, int32_t* items, float* ratings) {
+    FILE* f = std::fopen(path, "rb");
+    if (!f) return -1;
+    // Read whole file (rating files are <100 MB even at ML-20M scale).
+    std::fseek(f, 0, SEEK_END);
+    long size = std::ftell(f);
+    std::fseek(f, 0, SEEK_SET);
+    char* data = static_cast<char*>(std::malloc(size + 1));
+    if (!data) { std::fclose(f); return -1; }
+    size_t got = std::fread(data, 1, size, f);
+    std::fclose(f);
+    data[got] = '\0';
+
+    const char* p = data;
+    const char* end = data + got;
+    int64_t n = 0;
+    while (p < end && n < max_rows) {
+        while (p < end && (*p == ' ' || *p == '\t' || *p == '\r' || *p == '\n'))
+            ++p;
+        if (p >= end) break;
+        // user
+        int64_t u = 0;
+        while (p < end && *p >= '0' && *p <= '9') u = u * 10 + (*p++ - '0');
+        while (p < end && (*p == ' ' || *p == '\t')) ++p;
+        // item
+        int64_t it = 0;
+        while (p < end && *p >= '0' && *p <= '9') it = it * 10 + (*p++ - '0');
+        while (p < end && (*p == ' ' || *p == '\t')) ++p;
+        // rating (int or decimal)
+        double r = 0.0;
+        bool neg = false;
+        if (p < end && (*p == '-' || *p == '+')) neg = (*p++ == '-');
+        while (p < end && *p >= '0' && *p <= '9') r = r * 10 + (*p++ - '0');
+        if (p < end && *p == '.') {
+            ++p;
+            double scale = 0.1;
+            while (p < end && *p >= '0' && *p <= '9') {
+                r += (*p++ - '0') * scale;
+                scale *= 0.1;
+            }
+        }
+        users[n] = static_cast<int32_t>(u);
+        items[n] = static_cast<int32_t>(it);
+        ratings[n] = static_cast<float>(neg ? -r : r);
+        ++n;
+        while (p < end && *p != '\n') ++p;  // skip rest of line
+    }
+    std::free(data);
+    return n;
+}
+
+// Build a CSR grouping of row positions by id (counting sort, stable).
+// ids: (n,) int32 in [0, num_groups); indptr: (num_groups+1,) int64 out;
+// indices: (n,) int64 out. Returns 0, or -1 if an id is out of range.
+int32_t fia_build_csr(const int32_t* ids, int64_t n, int64_t num_groups,
+                      int64_t* indptr, int64_t* indices) {
+    std::memset(indptr, 0, sizeof(int64_t) * (num_groups + 1));
+    for (int64_t i = 0; i < n; ++i) {
+        int32_t g = ids[i];
+        if (g < 0 || g >= num_groups) return -1;
+        ++indptr[g + 1];
+    }
+    for (int64_t g = 0; g < num_groups; ++g) indptr[g + 1] += indptr[g];
+    // stable fill using a moving cursor per group
+    int64_t* cursor = static_cast<int64_t*>(
+        std::malloc(sizeof(int64_t) * num_groups));
+    std::memcpy(cursor, indptr, sizeof(int64_t) * num_groups);
+    for (int64_t i = 0; i < n; ++i) {
+        indices[cursor[ids[i]]++] = i;
+    }
+    std::free(cursor);
+    return 0;
+}
+
+}  // extern "C"

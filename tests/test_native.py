@@ -1,0 +1,65 @@
+"""Native data-path library: build, parse, CSR — vs numpy fallback."""
+
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+from fia_tpu.data import native
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def built():
+    r = subprocess.run(["make", "-C", os.path.join(REPO, "native")],
+                       capture_output=True, timeout=120)
+    if r.returncode != 0:
+        pytest.skip(f"native build failed: {r.stderr.decode()[:200]}")
+    assert native.available()
+    return True
+
+
+class TestNative:
+    def test_parse_tsv_matches_loadtxt(self, built, tmp_path):
+        rng = np.random.default_rng(0)
+        n = 1000
+        rows = np.stack([rng.integers(0, 500, n), rng.integers(0, 300, n),
+                         rng.integers(1, 6, n)], axis=1)
+        p = tmp_path / "r.rating"
+        np.savetxt(p, rows, fmt="%d", delimiter="\t")
+        u, i, r = native.parse_tsv(str(p))
+        assert np.array_equal(u, rows[:, 0]) and np.array_equal(i, rows[:, 1])
+        np.testing.assert_allclose(r, rows[:, 2])
+
+    def test_parse_tsv_decimal_and_maxrows(self, built, tmp_path):
+        p = tmp_path / "r.rating"
+        p.write_text("0\t1\t3.5\n2\t3\t4.25\n4\t5\t1\n")
+        u, i, r = native.parse_tsv(str(p), max_rows=2)
+        assert u.tolist() == [0, 2] and i.tolist() == [1, 3]
+        np.testing.assert_allclose(r, [3.5, 4.25])
+
+    def test_build_csr_matches_numpy(self, built):
+        rng = np.random.default_rng(1)
+        ids = rng.integers(0, 50, 5000).astype(np.int32)
+        indptr, indices = native.build_csr(ids, 50)
+        order = np.argsort(ids, kind="stable")
+        counts = np.bincount(ids, minlength=50)
+        want_indptr = np.zeros(51, np.int64)
+        np.cumsum(counts, out=want_indptr[1:])
+        assert np.array_equal(indptr, want_indptr)
+        assert np.array_equal(indices, order)
+
+    def test_build_csr_out_of_range(self, built):
+        with pytest.raises(ValueError):
+            native.build_csr(np.array([0, 7], np.int32), 5)
+
+    def test_loader_uses_native(self, built, tmp_path, monkeypatch):
+        from fia_tpu.data.loaders import _read_tsv
+
+        p = tmp_path / "x.rating"
+        p.write_text("0\t0\t5\n1\t1\t3\n")
+        ds = _read_tsv(str(p), None)
+        assert ds.num_examples == 2
+        assert ds.y.tolist() == [5.0, 3.0]
