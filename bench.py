@@ -64,13 +64,19 @@ def main():
     from fia_tpu.models import MF
     from fia_tpu.train.trainer import Trainer, TrainConfig
 
+    # Training length matters beyond MAE: the influence solvers only
+    # agree across implementations on a near-converged model (the damped
+    # block Hessian is then PD; far from convergence exact solves and
+    # early-stopping fmin_ncg legitimately diverge).
     if QUICK:
-        users, items, rows, steps, n_queries, n_base = 600, 400, 50_000, 500, 64, 2
+        users, items, rows, steps, n_queries, n_base = 600, 400, 50_000, 3_000, 64, 2
+        lr = 1e-2
     else:
         users, items, rows, steps, n_queries, n_base = (
-            6_040, 3_706, 975_460, 1_000, 256, 4
+            6_040, 3_706, 975_460, 15_000, 256, 4
         )
-    k, wd, damping, lr, batch = 16, 1e-3, 1e-6, 1e-3, 3020
+        lr = 1e-3
+    k, wd, damping, batch = 16, 1e-3, 1e-6, 3020
 
     train = synthesize_ratings(users, items, rows, seed=0)
     model = MF(users, items, k, wd)
@@ -124,6 +130,7 @@ def main():
             "num_scores": timing.num_scores,
             "cpu_ref_scores_per_sec": round(base_scores_per_sec, 1),
             "spearman_vs_cpu_ref_min": round(float(min(rhos)), 4),
+            "train_steps": steps,
         },
     }
     print(json.dumps(out))

@@ -1,0 +1,283 @@
+"""Reference-shaped facade.
+
+``FIAModel`` bundles model + trainer + influence engine behind the
+method surface a user of the reference's ``GenericNeuralNet``/``MF``/
+``NCF`` objects would look for (train / retrain / load_checkpoint /
+get_influence_on_test_loss / get_train_indices_of_test_case /
+print_model_eval / update_train_x_y ... — ``genericNeuralNet.py:82-891``,
+``matrix_factorization.py:21-433``), implemented over the functional
+TPU-native core. The pure-function layers remain the primary API; this
+wrapper is the migration path.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fia_tpu.data.dataset import RatingDataset
+from fia_tpu.influence.engine import InfluenceEngine
+from fia_tpu.influence.full import FullInfluenceEngine
+from fia_tpu.influence import grads as G
+from fia_tpu.influence.spectral import extreme_eigvals
+from fia_tpu.models import MODELS
+from fia_tpu.train import checkpoint
+from fia_tpu.train.trainer import Trainer, TrainConfig, TrainState
+
+
+class FIAModel:
+    """One object with the reference's workflow methods.
+
+    Args mirror the reference ctor kwargs (``RQ1.py:94-110``):
+      model: 'MF' or 'NCF' (or a LatentFactorModel instance)
+      num_users, num_items, embedding_size, weight_decay, batch_size,
+      data_sets: {'train','validation','test': RatingDataset},
+      initial_learning_rate, damping, avextol, train_dir, model_name.
+    """
+
+    def __init__(
+        self,
+        model: str,
+        num_users: int,
+        num_items: int,
+        embedding_size: int,
+        weight_decay: float,
+        batch_size: int,
+        data_sets: dict,
+        initial_learning_rate: float = 1e-3,
+        damping: float = 1e-6,
+        avextol: float = 1e-3,
+        train_dir: str = "output",
+        model_name: str = "fia_model",
+        solver: str = "direct",
+        seed: int = 0,
+        mesh=None,
+    ):
+        if isinstance(model, str):
+            model = MODELS[model](num_users, num_items, embedding_size, weight_decay)
+        self.model = model
+        self.data_sets = dict(data_sets)
+        self.batch_size = int(batch_size)
+        self.damping = float(damping)
+        self.avextol = float(avextol)
+        self.train_dir = train_dir
+        self.model_name = model_name
+        self.solver = solver
+        self.seed = seed
+        self.mesh = mesh
+        self.learning_rate = float(initial_learning_rate)
+
+        self._trainer = Trainer(
+            model,
+            TrainConfig(batch_size=batch_size, num_steps=0,
+                        learning_rate=initial_learning_rate, seed=seed),
+        )
+        params = model.init_params(jax.random.PRNGKey(seed))
+        self.state = self._trainer.init_state(params)
+        self._engine = None  # rebuilt lazily after params/train-set change
+
+    # -- properties --------------------------------------------------------
+    @property
+    def params(self):
+        return self.state.params
+
+    @property
+    def num_train_examples(self) -> int:
+        return self.data_sets["train"].num_examples
+
+    def _checkpoint_path(self, step: int) -> str:
+        return os.path.join(self.train_dir, f"{self.model_name}-checkpoint-{step}")
+
+    def engine(self) -> InfluenceEngine:
+        if self._engine is None:
+            self._engine = InfluenceEngine(
+                self.model, self.state.params, self.data_sets["train"],
+                damping=self.damping, solver=self.solver,
+                cache_dir=self.train_dir, model_name=self.model_name,
+                mesh=self.mesh,
+            )
+        return self._engine
+
+    def _invalidate(self):
+        self._engine = None
+
+    # -- training (genericNeuralNet.py:367-449) ----------------------------
+    def train(self, num_steps: int, iter_to_switch_to_batch: int | None = None,
+              iter_to_switch_to_sgd: int | None = None,
+              save_checkpoints: bool = True, verbose: bool = True,
+              load_checkpoints: int | bool = False):
+        if load_checkpoints:
+            self.load_checkpoint(int(load_checkpoints), do_checks=False)
+            remaining = max(0, num_steps - int(load_checkpoints) - 1)
+        else:
+            remaining = num_steps
+        self._trainer.config.iter_to_switch_to_batch = iter_to_switch_to_batch
+        self._trainer.config.iter_to_switch_to_sgd = iter_to_switch_to_sgd
+        if remaining:
+            train = self.data_sets["train"]
+            self.state = self._trainer.fit(self.state, train.x, train.y,
+                                           num_steps=remaining)
+            self._invalidate()
+        if save_checkpoints and num_steps > 0:
+            checkpoint.save(self._checkpoint_path(num_steps - 1),
+                            self.state.params, self.state.opt_state,
+                            self.state.step)
+        if verbose:
+            self.print_model_eval()
+
+    def retrain(self, num_steps: int, train: RatingDataset | None = None,
+                reset_adam: bool = True):
+        """Reference MF.retrain (matrix_factorization.py:69-76): reset the
+        optimizer, run minibatch steps on the given (possibly
+        leave-one-out) dataset."""
+        train = train or self.data_sets["train"]
+        self.state = self._trainer.retrain(self.state, train.x, train.y,
+                                           num_steps=num_steps,
+                                           reset_adam=reset_adam)
+        self._invalidate()
+
+    def load_checkpoint(self, iter_to_load: int, do_checks: bool = True):
+        p, o, step = checkpoint.load(self._checkpoint_path(iter_to_load),
+                                     self.state.params, self.state.opt_state)
+        p = jax.tree_util.tree_map(jnp.asarray, p)
+        if o is not None:
+            o = jax.tree_util.tree_map(jnp.asarray, o)
+        self.state = TrainState(p, o if o is not None else self.state.opt_state, step)
+        self._invalidate()
+        if do_checks:
+            self.print_model_eval()
+
+    # -- evaluation (genericNeuralNet.py:304-340) ---------------------------
+    def print_model_eval(self):
+        m, p = self.model, self.state.params
+        tr, te = self.data_sets["train"], self.data_sets["test"]
+        trx, tryy = jnp.asarray(tr.x), jnp.asarray(tr.y)
+        tex, tey = jnp.asarray(te.x), jnp.asarray(te.y)
+        loss_w = float(m.loss(p, trx, tryy))
+        loss_wo = float(m.loss_no_reg(p, trx, tryy))
+        test_loss = float(m.loss_no_reg(p, tex, tey))
+        train_mae = float(m.mae(p, trx, tryy))
+        test_mae = float(m.mae(p, tex, tey))
+        g = G.full_loss_grad(m, p, trx, tryy)
+        gnorm = float(
+            jnp.linalg.norm(
+                jnp.concatenate([jnp.ravel(l) for l in jax.tree_util.tree_leaves(g)])
+            )
+        )
+        print(f"Train loss (w reg) on all data: {loss_w}")
+        print(f"Train loss (w/o reg) on all data: {loss_wo}")
+        print(f"Test loss (w/o reg) on all data: {test_loss}")
+        print(f"Train acc on all data:  {train_mae}")
+        print(f"Test acc on all data:   {test_mae}")
+        print(f"Norm of the mean of gradients: {gnorm}")
+
+    # -- influence (matrix_factorization.py:164-251) ------------------------
+    def get_influence_on_test_loss(self, test_indices, train_idx=None,
+                                   approx_type: str | None = None,
+                                   approx_params=None, force_refresh=True,
+                                   test_description=None,
+                                   loss_type: str = "normal_loss"):
+        if loss_type != "normal_loss":
+            raise ValueError("loss must be normal_loss")
+        eng = self.engine()
+        if approx_type and approx_type != eng.solver:
+            solver = {"cg": "cg", "lissa": "lissa"}.get(approx_type, "direct")
+            eng = InfluenceEngine(
+                self.model, self.state.params, self.data_sets["train"],
+                damping=self.damping, solver=solver,
+                cache_dir=self.train_dir, model_name=self.model_name,
+                mesh=self.mesh,
+            )
+        return eng.get_influence_on_test_loss(
+            test_indices, self.data_sets["test"],
+            force_refresh=force_refresh, test_description=test_description,
+        )
+
+    def get_train_indices_of_test_case(self, test_indices):
+        assert len(test_indices) == 1
+        u, i = self.data_sets["test"].x[test_indices[0]]
+        return self.engine().index.related(int(u), int(i))
+
+    def get_test_params(self, test_index):
+        """The FIA block for a test point, as a pytree (reference returns
+        the sliced tensors, matrix_factorization.py:38-67)."""
+        u, i = self.data_sets["test"].x[test_index[0]]
+        return self.model.extract_block(self.state.params, int(u), int(i))
+
+    def get_inverse_hvp(self, v, approx_type="cg", approx_params=None):
+        """Full-parameter inverse HVP (genericNeuralNet.py:503-508)."""
+        full = FullInfluenceEngine(
+            self.model, self.state.params, self.data_sets["train"],
+            damping=self.damping, solver=approx_type, mesh=self.mesh,
+            **(approx_params or {}),
+        )
+        return full.get_inverse_hvp(v)
+
+    def find_eigvals_of_hessian(self, num_iters: int = 100):
+        """Working version of the reference's dead code
+        (genericNeuralNet.py:768-808): extreme eigenvalues of the full
+        training-loss Hessian by (shifted) power iteration."""
+        full = FullInfluenceEngine(
+            self.model, self.state.params, self.data_sets["train"],
+            damping=0.0,
+        )
+        lam_max, lam_min = extreme_eigvals(
+            full._hvp, full.num_params, num_iters=num_iters
+        )
+        return float(lam_max), float(lam_min)
+
+    def get_grad_of_influence_wrt_input(self, test_indices, train_indices):
+        """∂(influence of train row) / ∂(its embedding inputs).
+
+        The reference differentiates its influence op w.r.t. the input
+        placeholder (genericNeuralNet.py:811-867); ids are discrete here,
+        so the continuous analogue is the gradient w.r.t. the training
+        row's own embedding rows: rows of d(ihvp · ∇_block L(z))/d(emb).
+        Returns a list of pytrees, one per train index.
+        """
+        assert len(test_indices) == 1
+        test_ds = self.data_sets["test"]
+        train_ds = self.data_sets["train"]
+        u, i = (int(v) for v in test_ds.x[test_indices[0]])
+        eng = self.engine()
+        res = eng.query_batch(np.array([[u, i]]))
+        ihvp = jnp.asarray(res.ihvp[0])
+        model, params = self.model, self.state.params
+
+        out = []
+        for t in train_indices:
+            xj = jnp.asarray(train_ds.x[int(t)][None, :])
+            yj = jnp.asarray(train_ds.y[int(t)][None])
+            uj, ij = int(train_ds.x[int(t)][0]), int(train_ds.x[int(t)][1])
+
+            def influence_of_embeddings(emb):
+                # substitute this train row's embedding rows, recompute
+                # its block-restricted loss gradient, dot with the ihvp
+                p2 = model.with_block(params, emb, uj, ij)
+                g = G.block_loss_grad(model, p2, u, i, xj, yj)
+                return jnp.dot(g, ihvp)
+
+            emb0 = model.extract_block(params, uj, ij)
+            out.append(jax.grad(influence_of_embeddings)(emb0))
+        return out
+
+    # -- dataset mutation (genericNeuralNet.py:870-891) ---------------------
+    def update_train_x(self, new_x):
+        ds = self.data_sets["train"]
+        self.data_sets["train"] = RatingDataset(np.asarray(new_x), ds.y)
+        self._invalidate()
+
+    def update_train_x_y(self, new_x, new_y):
+        self.data_sets["train"] = RatingDataset(np.asarray(new_x), np.asarray(new_y))
+        self._invalidate()
+
+    def update_test_x_y(self, new_x, new_y):
+        self.data_sets["test"] = RatingDataset(np.asarray(new_x), np.asarray(new_y))
+
+    def reset_datasets(self):
+        for ds in self.data_sets.values():
+            if ds is not None:
+                ds.reset_batch()

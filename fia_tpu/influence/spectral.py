@@ -1,0 +1,65 @@
+"""Hessian spectrum tools.
+
+The reference ships a ``find_eigvals_of_hessian`` whose power-iteration
+loop was deleted (it reads ``norm_val`` before assignment,
+``genericNeuralNet.py:768-808`` — dead code). This is the working
+equivalent: power iteration for the dominant eigenvalue, with a shifted
+second pass for the smallest, usable on any matrix-free HVP (block or
+full-parameter); plus exact eigenvalues for materialised block Hessians.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def power_iteration(
+    hvp: Callable[[jnp.ndarray], jnp.ndarray],
+    dim: int,
+    num_iters: int = 100,
+    key=None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(eigval, eigvec) of the dominant eigenpair of the symmetric
+    operator ``hvp``."""
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    v0 = jax.random.normal(key, (dim,))
+    v0 = v0 / jnp.linalg.norm(v0)
+
+    def body(_, v):
+        w = hvp(v)
+        return w / jnp.maximum(jnp.linalg.norm(w), 1e-30)
+
+    v = lax.fori_loop(0, num_iters, body, v0)
+    lam = jnp.vdot(v, hvp(v))
+    return lam, v
+
+
+def extreme_eigvals(
+    hvp: Callable[[jnp.ndarray], jnp.ndarray],
+    dim: int,
+    num_iters: int = 100,
+    key=None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(largest, smallest) eigenvalues of the symmetric operator.
+
+    Smallest via the spectral shift H' = H - λ_max I (reference's
+    intended approach, per the surviving scaffolding at
+    ``genericNeuralNet.py:786-806``).
+    """
+    lam_max, _ = power_iteration(hvp, dim, num_iters, key)
+
+    def shifted(v):
+        return hvp(v) - lam_max * v
+
+    lam_shift, _ = power_iteration(shifted, dim, num_iters, key)
+    return lam_max, lam_shift + lam_max
+
+
+def block_hessian_eigvals(H: jnp.ndarray) -> jnp.ndarray:
+    """Exact spectrum of a materialised (tiny) block Hessian."""
+    return jnp.linalg.eigvalsh(H)

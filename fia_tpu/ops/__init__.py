@@ -1,0 +1,1 @@
+from fia_tpu.ops.score_mf import mf_influence_scores  # noqa: F401

@@ -1,0 +1,88 @@
+"""FIAModel facade: the reference-shaped workflow surface."""
+
+import numpy as np
+import pytest
+
+from fia_tpu.api import FIAModel
+from fia_tpu.influence.spectral import block_hessian_eigvals, extreme_eigvals
+
+
+@pytest.fixture(scope="module")
+def fia(tiny_splits, tmp_path_factory):
+    train = tiny_splits["train"]
+    m = FIAModel(
+        model="MF",
+        num_users=train.num_users,
+        num_items=train.num_items,
+        embedding_size=4,
+        weight_decay=1e-3,
+        batch_size=200,
+        data_sets=tiny_splits,
+        initial_learning_rate=1e-2,
+        damping=1e-4,
+        train_dir=str(tmp_path_factory.mktemp("out")),
+        model_name="t",
+    )
+    m.train(num_steps=600, verbose=False)
+    return m
+
+
+class TestFacade:
+    def test_train_and_checkpoint_roundtrip(self, fia):
+        p_before = np.asarray(fia.params["P"])
+        fia.load_checkpoint(599, do_checks=False)
+        np.testing.assert_allclose(np.asarray(fia.params["P"]), p_before)
+
+    def test_influence_and_related(self, fia):
+        scores = fia.get_influence_on_test_loss([0])
+        rel = fia.get_train_indices_of_test_case([0])
+        assert scores.shape == rel.shape
+        assert np.isfinite(scores).all()
+
+    def test_test_params_block(self, fia):
+        block = fia.get_test_params([0])
+        assert set(block) == {"pu", "qi", "bu", "bi"}
+
+    def test_retrain_changes_params(self, fia):
+        p_before = np.asarray(fia.params["P"]).copy()
+        fia.retrain(num_steps=20)
+        assert not np.allclose(np.asarray(fia.params["P"]), p_before)
+        fia.load_checkpoint(599, do_checks=False)
+
+    def test_eigvals(self, fia):
+        lam_max, lam_min = fia.find_eigvals_of_hessian(num_iters=50)
+        assert np.isfinite(lam_max) and np.isfinite(lam_min)
+        assert lam_max >= lam_min
+
+    def test_grad_of_influence_wrt_input(self, fia):
+        rel = fia.get_train_indices_of_test_case([0])
+        out = fia.get_grad_of_influence_wrt_input([0], rel[:2])
+        assert len(out) == 2
+        for g in out:
+            assert set(g) == {"pu", "qi", "bu", "bi"}
+
+    def test_update_datasets(self, fia, tiny_splits):
+        n = fia.num_train_examples
+        tr = tiny_splits["train"]
+        fia.update_train_x_y(tr.x[: n - 5], tr.y[: n - 5])
+        assert fia.num_train_examples == n - 5
+        fia.update_train_x_y(tr.x, tr.y)
+
+
+class TestSpectral:
+    def test_power_iteration_matches_eigh(self):
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(0)
+        A = rng.normal(size=(12, 12))
+        H = jnp.asarray(A @ A.T, jnp.float32)
+        lam_max, lam_min = extreme_eigvals(lambda v: H @ v, 12, num_iters=500)
+        w = np.linalg.eigvalsh(np.asarray(H))
+        np.testing.assert_allclose(float(lam_max), w[-1], rtol=1e-3)
+        np.testing.assert_allclose(float(lam_min), w[0], atol=1e-2 * w[-1])
+
+    def test_block_eigvals(self):
+        import jax.numpy as jnp
+
+        H = jnp.diag(jnp.array([1.0, 2.0, 3.0]))
+        np.testing.assert_allclose(block_hessian_eigvals(H), [1.0, 2.0, 3.0])
