@@ -62,7 +62,9 @@ class InfluenceEngine:
         'cg' (matrix-free, fmin_ncg-equivalent on this quadratic), or
         'lissa'.
       mesh: optional jax Mesh with a 'data' axis; query batches are then
-        sharded across it.
+        sharded across it. With a 2-D ('data', 'model') mesh, pass
+        ``shard_tables=True`` to row-shard the embedding tables over the
+        'model' axis (stress configs whose tables exceed one device).
       cache_dir: if set, inverse-HVPs are cached as npz files keyed like
         the reference (``matrix_factorization.py:210-222``).
     """
@@ -83,11 +85,18 @@ class InfluenceEngine:
         model_name: str = "model",
         pad_bucket: int = 128,
         use_pallas: bool = False,
+        shard_tables: bool = False,
     ):
         if solver not in ("direct", "cg", "lissa"):
             raise ValueError(f"unknown solver {solver!r}")
         self.model = model
         self.params = jax.tree_util.tree_map(jnp.asarray, params)
+        if shard_tables:
+            if mesh is None or "model" not in mesh.axis_names:
+                raise ValueError("shard_tables requires a mesh with a 'model' axis")
+            from fia_tpu.parallel.sharded import shard_model_params
+
+            self.params = shard_model_params(mesh, self.params, model)
         self.train_x = jnp.asarray(train.x)
         self.train_y = jnp.asarray(train.y)
         self.index = InteractionIndex(train.x, model.num_users, model.num_items)

@@ -1,0 +1,52 @@
+"""Model-parallel (sharded embedding table) configuration.
+
+The scaling axes of this workload are #users/#items (embedding-table
+rows) and #queries/#train-rows (data) — there is no sequence dimension
+(SURVEY.md §2.4). For stress configs whose tables exceed one device's
+HBM (e.g. MovieLens-20M at large k), tables are row-sharded over a
+'model' mesh axis while queries/batches shard over 'data'; XLA inserts
+the gather/psum collectives over ICI.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+#: param names holding per-user/per-item rows, per model class name
+TABLE_PARAMS = {
+    "MF": ("P", "Q", "bu", "bi"),
+    "NCF": ("P_mlp", "Q_mlp", "P_gmf", "Q_gmf"),
+}
+
+
+def make_2d_mesh(n_devices: int | None = None, model_parallel: int = 2) -> Mesh:
+    """('data', 'model') mesh; model_parallel divides the device count."""
+    devs = jax.devices() if n_devices is None else jax.devices()[:n_devices]
+    n = len(devs)
+    mp = model_parallel if n % model_parallel == 0 else 1
+    return Mesh(np.asarray(devs).reshape(n // mp, mp), ("data", "model"))
+
+
+def shard_model_params(mesh: Mesh, params, model, axis: str = "model"):
+    """Row-shard the embedding tables over ``axis``; replicate the rest.
+
+    Row counts not divisible by the axis size are handled by XLA's
+    implicit padding of sharded dimensions.
+    """
+    names = TABLE_PARAMS.get(type(model).__name__, ())
+    out = {}
+    for k, v in params.items():
+        if k in names:
+            spec = P(axis, *([None] * (v.ndim - 1)))
+        else:
+            spec = P()
+        out[k] = jax.device_put(v, NamedSharding(mesh, spec))
+    return out
+
+
+def replicate_rest(mesh: Mesh, tree):
+    return jax.tree_util.tree_map(
+        lambda v: jax.device_put(v, NamedSharding(mesh, P())), tree
+    )
