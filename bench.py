@@ -27,7 +27,7 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 QUICK = "--quick" in sys.argv
 
 
-def _ensure_live_backend(timeout_s: int = 180) -> None:
+def _ensure_live_backend(timeout_s: int = 90) -> None:
     """Probe the default JAX backend in a subprocess; if it cannot
     initialise (e.g. the TPU tunnel is down), fall back to CPU rather
     than hanging the benchmark forever."""

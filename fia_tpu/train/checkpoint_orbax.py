@@ -1,0 +1,47 @@
+"""Orbax-backed checkpointing (async/multi-host capable alternative to
+the npz checkpoints in ``checkpoint.py``). Same (params, opt_state,
+step) contract; use for sharded params that must restore with their
+shardings intact."""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.StandardCheckpointer()
+
+
+def save(path: str, params, opt_state=None, step: int = 0) -> str:
+    path = os.path.abspath(path)
+    ckptr = _checkpointer()
+    payload = {"params": params, "step": step}
+    if opt_state is not None:
+        payload["opt_state"] = opt_state
+    ckptr.save(path, payload, force=True)
+    ckptr.wait_until_finished()
+    return path
+
+
+def load(path: str, params_template, opt_template=None):
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    ckptr = _checkpointer()
+    target = {"params": params_template, "step": 0}
+    if opt_template is not None:
+        target["opt_state"] = opt_template
+    restored = ckptr.restore(path, target)
+    return (
+        restored["params"],
+        restored.get("opt_state"),
+        int(restored["step"]),
+    )
+
+
+def exists(path: str) -> bool:
+    return os.path.isdir(os.path.abspath(path))

@@ -42,11 +42,12 @@ class TrainState:
 
 
 class Trainer:
-    def __init__(self, model, config: TrainConfig):
+    def __init__(self, model, config: TrainConfig, event_log=None):
         self.model = model
         self.config = config
         self.optimizer = optax.adam(config.learning_rate)
         self.sgd = optax.sgd(config.learning_rate * 10.0)
+        self.event_log = event_log  # utils.logging.EventLog or None
         self._epoch_fn = None
         self._full_fns = {}
 
@@ -159,6 +160,11 @@ class Trainer:
             epoch_i += 1
             if cfg.log_every and (epoch_i % max(1, cfg.log_every // nb) == 0):
                 print(f"step {state.step + done}: loss = {float(losses[todo - 1]):.6f}")
+            if self.event_log is not None:
+                self.event_log.log(
+                    "train_epoch", epoch=epoch_i, step=state.step + done,
+                    loss=float(losses[todo - 1]),
+                )
 
         if batch_steps > 0:
             fn = self._full_fns.setdefault(False, self._make_full_fn(False))

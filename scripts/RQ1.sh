@@ -1,0 +1,14 @@
+#!/bin/sh
+# RQ1 fidelity sweeps: MF/NCF x movielens/yelp with the per-combo step
+# counts of the reference experiment scripts (reference RQ1.sh) — with
+# flags that are actually honored (the reference's argparse was commented
+# out, so its sweeps silently all ran one config; SURVEY.md §2.3).
+set -e
+cd "$(dirname "$0")/.."
+DATA=${DATA:-/root/reference/data}
+OUT=${OUT:-output}
+
+python -m fia_tpu.cli.rq1 --model MF  --dataset yelp      --num_steps_train 80000  --num_steps_retrain 24000 --data_dir "$DATA" --train_dir "$OUT" > "$OUT/RQ1_MF_yelp.log" 2>&1
+python -m fia_tpu.cli.rq1 --model MF  --dataset movielens --num_steps_train 80000  --num_steps_retrain 24000 --data_dir "$DATA" --train_dir "$OUT" > "$OUT/RQ1_MF_movielens.log" 2>&1
+python -m fia_tpu.cli.rq1 --model NCF --dataset yelp      --num_steps_train 120000 --num_steps_retrain 18000 --data_dir "$DATA" --train_dir "$OUT" > "$OUT/RQ1_NCF_yelp.log" 2>&1
+python -m fia_tpu.cli.rq1 --model NCF --dataset movielens --num_steps_train 120000 --num_steps_retrain 18000 --data_dir "$DATA" --train_dir "$OUT" > "$OUT/RQ1_NCF_movielens.log" 2>&1

@@ -1,0 +1,13 @@
+#!/bin/sh
+# RQ2 time-cost sweep over embedding sizes 8..256 (the sweep the
+# reference's RQ2.sh intended but silently dropped; SURVEY.md §2.3).
+set -e
+cd "$(dirname "$0")/.."
+DATA=${DATA:-/root/reference/data}
+OUT=${OUT:-output}
+
+for K in 8 16 32 64 128 256; do
+  python -m fia_tpu.cli.rq2 --embed_size "$K" --dataset movielens --model MF \
+    --data_dir "$DATA" --train_dir "$OUT" --num_test 64 \
+    > "$OUT/RQ2_MF_movielens_k$K.log" 2>&1
+done

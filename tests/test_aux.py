@@ -1,0 +1,80 @@
+"""Auxiliary subsystems: structured logging, orbax checkpoints, real
+reference data loading (skipped when the mount is absent)."""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from fia_tpu.utils.logging import EventLog, read_events
+
+REF_DATA = "/root/reference/data"
+
+
+class TestEventLog:
+    def test_roundtrip(self, tmp_path):
+        p = str(tmp_path / "log" / "events.jsonl")
+        with EventLog(p) as log:
+            log.log("train_epoch", epoch=1, loss=0.5)
+            log.log("query", n=4)
+        ev = read_events(p)
+        assert [e["event"] for e in ev] == ["train_epoch", "query"]
+        assert ev[0]["loss"] == 0.5
+
+    def test_disabled_is_noop(self):
+        log = EventLog(None)
+        log.log("x", a=1)  # must not raise
+        log.close()
+
+    def test_trainer_emits_events(self, tiny_splits, tmp_path):
+        from fia_tpu.models import MF
+        from fia_tpu.train.trainer import Trainer, TrainConfig
+
+        train = tiny_splits["train"]
+        model = MF(train.num_users, train.num_items, 4, 1e-3)
+        params = model.init_params(jax.random.PRNGKey(0))
+        p = str(tmp_path / "ev.jsonl")
+        with EventLog(p) as log:
+            tr = Trainer(model, TrainConfig(batch_size=500, num_steps=8,
+                                            log_every=1), event_log=log)
+            tr.fit(tr.init_state(params), train.x, train.y)
+        ev = read_events(p)
+        assert any(e["event"] == "train_epoch" for e in ev)
+
+
+class TestOrbaxCheckpoint:
+    def test_roundtrip(self, tmp_path):
+        from fia_tpu.train import checkpoint_orbax as co
+
+        params = {"a": np.arange(6, dtype=np.float32).reshape(2, 3),
+                  "b": np.zeros(4, np.float32)}
+        path = co.save(str(tmp_path / "ck"), params, step=7)
+        assert co.exists(path)
+        p2, o2, step = co.load(path, params)
+        assert step == 7 and o2 is None
+        np.testing.assert_allclose(p2["a"], params["a"])
+
+
+@pytest.mark.skipif(not os.path.isdir(REF_DATA),
+                    reason="reference data not mounted")
+class TestReferenceData:
+    def test_movielens_counts(self):
+        """Slicing parity with BASELINE.md §2: 12,074 valid/test rows,
+        6,040 users, 3,706 items; train synthesized at 975,460 rows."""
+        from fia_tpu.data.loaders import load_movielens
+
+        splits = load_movielens(REF_DATA)
+        assert splits["validation"].num_examples == 12_074
+        assert splits["test"].num_examples == 12_074
+        assert splits["train"].num_examples == 975_460
+        users = max(s.x[:, 0].max() for s in splits.values()) + 1
+        items = max(s.x[:, 1].max() for s in splits.values()) + 1
+        assert users == 6_040 and items == 3_706
+
+    def test_yelp_counts(self):
+        from fia_tpu.data.loaders import load_yelp
+
+        splits = load_yelp(REF_DATA)
+        assert splits["test"].num_examples == 51_153
+        assert splits["train"].num_examples == 628_881
