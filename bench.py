@@ -27,6 +27,11 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 QUICK = "--quick" in sys.argv
 
 
+def _stage(msg: str) -> None:
+    """Progress marker on stderr (stdout carries only the JSON line)."""
+    print(f"bench[{time.strftime('%H:%M:%S')}]: {msg}", file=sys.stderr, flush=True)
+
+
 def _ensure_live_backend(timeout_s: int = 90) -> None:
     """Probe the default JAX backend in a subprocess; if it cannot
     initialise (e.g. the TPU tunnel is down), fall back to CPU rather
@@ -78,15 +83,18 @@ def main():
         lr = 1e-3
     k, wd, damping, batch = 16, 1e-3, 1e-6, 3020
 
+    _stage(f"backend={jax.default_backend()} devices={jax.device_count()}")
     train = synthesize_ratings(users, items, rows, seed=0)
     model = MF(users, items, k, wd)
     params = model.init_params(jax.random.PRNGKey(0))
 
     # brief training so the block Hessians look like the real workload's
+    _stage(f"training: {steps} steps on {rows} rows")
     tr = Trainer(model, TrainConfig(batch_size=batch, num_steps=steps,
                                     learning_rate=lr))
     state = tr.fit(tr.init_state(params), train.x, train.y)
     params = state.params
+    _stage("training done; building influence engine")
 
     engine = InfluenceEngine(model, params, train, damping=damping,
                              solver="direct", pad_bucket=512)
@@ -95,7 +103,10 @@ def main():
     qi = rng.integers(0, items, n_queries)
     points = np.stack([qu, qi], axis=1).astype(np.int32)
 
+    _stage(f"timing {n_queries} influence queries")
     timing = time_influence_queries(engine, points, repeats=3)
+    _stage(f"jax path done ({timing.scores_per_sec:.0f} scores/s); "
+           f"running CPU reference on {n_base} queries")
 
     # --- CPU baseline (reference-architecture engine) on a sample -------
     host = jax.tree_util.tree_map(np.asarray, params)

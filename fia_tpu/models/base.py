@@ -109,6 +109,19 @@ class LatentFactorModel:
         """Reference 'accuracy' op (``matrix_factorization.py:134-146``)."""
         return jnp.mean(jnp.abs(self.predict(params, x) - y))
 
+    def adversarial_loss(self, params: Params, x, y):
+        """Adversarial-loss hook. ``None`` for rating regression.
+
+        The reference base class carries a classification log(1-p) loss
+        (``genericNeuralNet.py:481-494``, a Koh & Liang leftover), and both
+        MF and NCF disable it by returning ``(None, None)``
+        (``matrix_factorization.py:148-150``, ``NCF.py:177-179``); the
+        ``loss_type='adversarial_loss'`` branches of their influence paths
+        are commented out (``matrix_factorization.py:258-259``). Kept as an
+        overridable hook so a classification model family can supply one.
+        """
+        return None, None
+
     def num_params(self) -> int:
         shapes = jax.eval_shape(self.init_params, jax.random.PRNGKey(0))
         return sum(math.prod(l.shape) for l in jax.tree_util.tree_leaves(shapes))

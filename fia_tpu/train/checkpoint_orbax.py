@@ -28,14 +28,25 @@ def save(path: str, params, opt_state=None, step: int = 0) -> str:
 
 
 def load(path: str, params_template, opt_template=None):
-    import orbax.checkpoint as ocp
-
     path = os.path.abspath(path)
     ckptr = _checkpointer()
     target = {"params": params_template, "step": 0}
     if opt_template is not None:
         target["opt_state"] = opt_template
-    restored = ckptr.restore(path, target)
+    # Orbax's strict restore rejects any structure mismatch between the
+    # saved payload and the target, so a checkpoint saved with opt_state
+    # must be readable without a template (and vice versa): retry with
+    # the opposite opt_state arrangement before giving up.
+    try:
+        restored = ckptr.restore(path, target)
+    except ValueError:
+        if opt_template is not None:
+            target.pop("opt_state")
+        else:
+            restored_raw = ckptr.restore(path)
+            restored_raw.pop("opt_state", None)
+            return (restored_raw["params"], None, int(restored_raw["step"]))
+        restored = ckptr.restore(path, target)
     return (
         restored["params"],
         restored.get("opt_state"),

@@ -7,6 +7,7 @@ set -e
 cd "$(dirname "$0")/.."
 DATA=${DATA:-/root/reference/data}
 OUT=${OUT:-output}
+mkdir -p "$OUT"
 
 python -m fia_tpu.cli.rq1 --model MF  --dataset yelp      --num_steps_train 80000  --num_steps_retrain 24000 --data_dir "$DATA" --train_dir "$OUT" > "$OUT/RQ1_MF_yelp.log" 2>&1
 python -m fia_tpu.cli.rq1 --model MF  --dataset movielens --num_steps_train 80000  --num_steps_retrain 24000 --data_dir "$DATA" --train_dir "$OUT" > "$OUT/RQ1_MF_movielens.log" 2>&1

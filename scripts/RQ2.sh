@@ -5,6 +5,7 @@ set -e
 cd "$(dirname "$0")/.."
 DATA=${DATA:-/root/reference/data}
 OUT=${OUT:-output}
+mkdir -p "$OUT"
 
 for K in 8 16 32 64 128 256; do
   python -m fia_tpu.cli.rq2 --embed_size "$K" --dataset movielens --model MF \
