@@ -98,10 +98,18 @@ def main():
 
     engine = InfluenceEngine(model, params, train, damping=damping,
                              solver="direct", pad_bucket=512)
+    # Query points are held-out (u, i) pairs, as in the reference's RQ1/RQ2
+    # (test split is disjoint from train). A pair present in train couples
+    # the p_u/q_i blocks through its residual and can make the related-set
+    # block Hessian indefinite — a regime the reference never queries.
     rng = np.random.default_rng(17)
-    qu = rng.integers(0, users, n_queries)
-    qi = rng.integers(0, items, n_queries)
-    points = np.stack([qu, qi], axis=1).astype(np.int32)
+    train_pairs = set(map(tuple, train.x.tolist()))
+    pts = []
+    while len(pts) < n_queries:
+        u, i = int(rng.integers(0, users)), int(rng.integers(0, items))
+        if (u, i) not in train_pairs:
+            pts.append((u, i))
+    points = np.asarray(pts, dtype=np.int32)
 
     _stage(f"timing {n_queries} influence queries")
     timing = time_influence_queries(engine, points, repeats=3)

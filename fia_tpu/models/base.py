@@ -33,6 +33,17 @@ def truncated_normal(key, shape, stddev, dtype=jnp.float32):
     return stddev * jax.random.truncated_normal(key, -2.0, 2.0, shape, dtype)
 
 
+def _weighted_mean(err: jnp.ndarray, w) -> jnp.ndarray:
+    """Plain mean, or masked mean sum(w·err)/max(sum(w), 1) when ``w`` is
+    given — reproduces the reference's mean over whichever rows were fed
+    (``matrix_factorization.py:122-132``) while letting padded callers
+    mask rows out."""
+    if w is None:
+        return jnp.mean(err)
+    w = w.astype(err.dtype)
+    return jnp.sum(w * err) / jnp.maximum(jnp.sum(w), 1.0)
+
+
 class LatentFactorModel:
     """Base class; subclasses define the forward pass and the FIA block."""
 
@@ -91,19 +102,10 @@ class LatentFactorModel:
         while letting padded/batched callers mask rows out.
         """
         err = self.indiv_loss(params, x, y)
-        if w is None:
-            mse = jnp.mean(err)
-        else:
-            w = w.astype(err.dtype)
-            mse = jnp.sum(w * err) / jnp.maximum(jnp.sum(w), 1.0)
-        return mse + self.reg_loss(params)
+        return _weighted_mean(err, w) + self.reg_loss(params)
 
     def loss_no_reg(self, params: Params, x, y, w=None) -> jnp.ndarray:
-        err = self.indiv_loss(params, x, y)
-        if w is None:
-            return jnp.mean(err)
-        w = w.astype(err.dtype)
-        return jnp.sum(w * err) / jnp.maximum(jnp.sum(w), 1.0)
+        return _weighted_mean(self.indiv_loss(params, x, y), w)
 
     def mae(self, params: Params, x, y) -> jnp.ndarray:
         """Reference 'accuracy' op (``matrix_factorization.py:134-146``)."""
@@ -137,8 +139,19 @@ class LatentFactorModel:
         """
         return self.predict(self.with_block(params, block, u, i), x)
 
+    def block_reg(self, params: Params, block: Block, u, i) -> jnp.ndarray:
+        """L2 regulariser with the (u, i) block substituted.
+
+        Subclasses override with the scatter-free form
+        ``reg(params) + wd/2 * (‖block rows‖² − ‖table rows‖²)`` — the
+        full-table reduction is block-independent and stays unbatched
+        under vmap, so only O(block) work is batched.
+        """
+        return self.reg_loss(self.with_block(params, block, u, i))
+
     def block_loss(self, params: Params, block: Block, u, i, x, y, w=None):
-        return self.loss(self.with_block(params, block, u, i), x, y, w)
+        err = jnp.square(self.block_predict(params, block, u, i, x) - y)
+        return _weighted_mean(err, w) + self.block_reg(params, block, u, i)
 
     def flatten_block(self, block: Block) -> jnp.ndarray:
         keys = self.block_keys or tuple(sorted(block))

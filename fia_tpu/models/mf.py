@@ -61,6 +61,31 @@ class MF(LatentFactorModel):
             "bg": params["bg"],
         }
 
+    # Scatter-free block substitution: predictions gather only the rows
+    # of ``x`` and select the block values where the row's user/item is
+    # (u, i). Gradients w.r.t. the block are identical to substituting
+    # into the full tables, but nothing table-sized is ever built inside
+    # the vmapped influence query (the .at[u].set path materialises a
+    # full (U, k) copy per vmap instance on TPU and OOMs at scale).
+    def block_predict(self, params, block, u, i, x):
+        xu, xi = x[:, 0], x[:, 1]
+        mu = (xu == u)[:, None]
+        mi = (xi == i)[:, None]
+        pu = jnp.where(mu, block["pu"][None, :], params["P"][xu])
+        qi = jnp.where(mi, block["qi"][None, :], params["Q"][xi])
+        bu = jnp.where(xu == u, block["bu"], params["bu"][xu])
+        bi = jnp.where(xi == i, block["bi"], params["bi"][xi])
+        return jnp.sum(pu * qi, axis=-1) + bu + bi + params["bg"]
+
+    def block_reg(self, params, block, u, i):
+        corr = (
+            jnp.sum(jnp.square(block["pu"]))
+            - jnp.sum(jnp.square(params["P"][u]))
+            + jnp.sum(jnp.square(block["qi"]))
+            - jnp.sum(jnp.square(params["Q"][i]))
+        )
+        return self.reg_loss(params) + 0.5 * self.weight_decay * corr
+
     @property
     def block_size(self) -> int:
         return 2 * self.embedding_size + 2

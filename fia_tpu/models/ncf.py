@@ -69,6 +69,35 @@ class NCF(LatentFactorModel):
         out["Q_gmf"] = params["Q_gmf"].at[i].set(block["qi_gmf"])
         return out
 
+    # Scatter-free block substitution (see MF.block_predict): gather the
+    # batch rows and select block values where the row hits (u, i) —
+    # avoids materialising full (U, k) table copies per vmap instance.
+    def block_predict(self, params, block, u, i, x):
+        xu, xi = x[:, 0], x[:, 1]
+        mu = (xu == u)[:, None]
+        mi = (xi == i)[:, None]
+        pm = jnp.where(mu, block["pu_mlp"][None, :], params["P_mlp"][xu])
+        qm = jnp.where(mi, block["qi_mlp"][None, :], params["Q_mlp"][xi])
+        pg = jnp.where(mu, block["pu_gmf"][None, :], params["P_gmf"][xu])
+        qg = jnp.where(mi, block["qi_gmf"][None, :], params["Q_gmf"][xi])
+        h1 = jax.nn.relu(jnp.concatenate([pm, qm], axis=-1) @ params["W1"] + params["b1"])
+        h2 = jax.nn.relu(h1 @ params["W2"] + params["b2"])
+        h = jnp.concatenate([h2, pg * qg], axis=-1)
+        return jnp.squeeze(h @ params["W3"] + params["b3"], axis=-1)
+
+    def block_reg(self, params, block, u, i):
+        corr = (
+            jnp.sum(jnp.square(block["pu_mlp"]))
+            - jnp.sum(jnp.square(params["P_mlp"][u]))
+            + jnp.sum(jnp.square(block["qi_mlp"]))
+            - jnp.sum(jnp.square(params["Q_mlp"][i]))
+            + jnp.sum(jnp.square(block["pu_gmf"]))
+            - jnp.sum(jnp.square(params["P_gmf"][u]))
+            + jnp.sum(jnp.square(block["qi_gmf"]))
+            - jnp.sum(jnp.square(params["Q_gmf"][i]))
+        )
+        return self.reg_loss(params) + 0.5 * self.weight_decay * corr
+
     @property
     def block_size(self) -> int:
         return 4 * self.embedding_size

@@ -27,6 +27,21 @@ def save(path: str, params, opt_state=None, step: int = 0) -> str:
     return path
 
 
+def _check_like(template, got):
+    """Raise if ``got`` doesn't match the template's tree/shapes — the
+    template-less raw restore must not accept a mismatched checkpoint."""
+    tdef = jax.tree_util.tree_structure(template)
+    gdef = jax.tree_util.tree_structure(got)
+    if tdef != gdef:
+        raise ValueError(f"checkpoint params tree {gdef} != template {tdef}")
+    for t, g in zip(jax.tree_util.tree_leaves(template),
+                    jax.tree_util.tree_leaves(got)):
+        ts = tuple(getattr(t, "shape", ()))
+        gs = tuple(getattr(g, "shape", ()))
+        if ts != gs:
+            raise ValueError(f"checkpoint leaf shape {gs} != template {ts}")
+
+
 def load(path: str, params_template, opt_template=None):
     path = os.path.abspath(path)
     ckptr = _checkpointer()
@@ -42,11 +57,17 @@ def load(path: str, params_template, opt_template=None):
     except ValueError:
         if opt_template is not None:
             target.pop("opt_state")
+            restored = ckptr.restore(path, target)
         else:
             restored_raw = ckptr.restore(path)
             restored_raw.pop("opt_state", None)
-            return (restored_raw["params"], None, int(restored_raw["step"]))
-        restored = ckptr.restore(path, target)
+            _check_like(params_template, restored_raw["params"])
+            params = jax.tree_util.tree_map(
+                lambda t, g: jax.device_put(g, t.sharding)
+                if isinstance(t, jax.Array) else g,
+                params_template, restored_raw["params"],
+            )
+            return (params, None, int(restored_raw["step"]))
     return (
         restored["params"],
         restored.get("opt_state"),

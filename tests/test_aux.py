@@ -55,6 +55,24 @@ class TestOrbaxCheckpoint:
         assert step == 7 and o2 is None
         np.testing.assert_allclose(p2["a"], params["a"])
 
+    def test_asymmetric_restore_validates_template(self, tmp_path):
+        """A checkpoint saved WITH opt_state restores through the raw
+        fallback when loaded without one — but a template whose shapes
+        don't match must still be rejected, not silently ignored."""
+        from fia_tpu.train import checkpoint_orbax as co
+
+        params = {"a": np.arange(6, dtype=np.float32).reshape(2, 3)}
+        opt = {"m": np.zeros((2, 3), np.float32)}
+        path = co.save(str(tmp_path / "ck"), params, opt_state=opt, step=3)
+
+        p2, o2, step = co.load(path, params)  # no opt template: raw path
+        assert step == 3 and o2 is None
+        np.testing.assert_allclose(p2["a"], params["a"])
+
+        bad = {"a": np.zeros((4, 5), np.float32)}
+        with pytest.raises(ValueError):
+            co.load(path, bad)
+
 
 @pytest.mark.skipif(not os.path.isdir(REF_DATA),
                     reason="reference data not mounted")
