@@ -89,9 +89,16 @@ class LatentFactorModel:
             reg = reg + 0.5 * jnp.sum(jnp.square(params[name]))
         return self.weight_decay * reg
 
+    def indiv_loss_from_pred(self, pred: jnp.ndarray, y) -> jnp.ndarray:
+        """Per-example loss given predictions, (B,). The single hook a
+        subclass overrides to change the per-example loss — both the
+        training loss and the block-restricted influence loss route
+        through it."""
+        return jnp.square(pred - y)
+
     def indiv_loss(self, params: Params, x, y) -> jnp.ndarray:
-        """Per-example squared error, (B,)."""
-        return jnp.square(self.predict(params, x) - y)
+        """Per-example loss, (B,)."""
+        return self.indiv_loss_from_pred(self.predict(params, x), y)
 
     def loss(self, params: Params, x, y, w=None) -> jnp.ndarray:
         """Total training loss: (weighted-)mean squared error + L2.
@@ -149,8 +156,16 @@ class LatentFactorModel:
         """
         return self.reg_loss(self.with_block(params, block, u, i))
 
+    #: optional closed-form block Hessian hook. When a subclass defines
+    #: ``block_hessian(params, u, i, x, y, w) -> (d, d)`` (undamped), the
+    #: influence engine's direct solver uses it instead of materialising
+    #: the Hessian through ``block_size`` autodiff HVPs.
+    block_hessian = None
+
     def block_loss(self, params: Params, block: Block, u, i, x, y, w=None):
-        err = jnp.square(self.block_predict(params, block, u, i, x) - y)
+        err = self.indiv_loss_from_pred(
+            self.block_predict(params, block, u, i, x), y
+        )
         return _weighted_mean(err, w) + self.block_reg(params, block, u, i)
 
     def flatten_block(self, block: Block) -> jnp.ndarray:

@@ -1,13 +1,13 @@
 """Probe: how does JAX-engine vs torch-ref Spearman parity depend on
 training convergence and solver, at the quick-bench scale?"""
 
+import os
 import sys
-import time
 
 import numpy as np
 import jax
 
-sys.path.insert(0, "/root/repo")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from fia_tpu.backends.torch_ref import TorchRefMFEngine
 from fia_tpu.data.synthetic import synthesize_ratings

@@ -66,6 +66,41 @@ class TestBlockHVP:
             # and jax.hessian is a few ulp at this scale
             np.testing.assert_allclose(hvp(v), want, rtol=1e-2, atol=5e-5)
 
+    def test_analytic_block_hessian_matches_autodiff(self, model_cls):
+        """MF's closed-form block Hessian == the autodiff-materialised
+        one, on a related set that includes the query pair itself (the
+        e_j cross-term case) and padding rows masked out."""
+        if model_cls is not MF:
+            pytest.skip("closed form implemented for MF only")
+        model, params, train = _setup(model_cls)
+        u, i = 3, 5
+        # ensure a (u, i) row exists so the residual cross term is live
+        x = np.vstack([train.x, [[u, i]]]).astype(np.int32)
+        y = np.append(train.y, 2.0).astype(np.float32)
+        idx = InteractionIndex(RatingDataset(x, y).x).related(u, i)
+        pad = 8  # extra masked rows must not perturb the Hessian
+        rel_x = jnp.asarray(np.vstack([x[idx], x[:pad]]))
+        rel_y = jnp.asarray(np.append(y[idx], y[:pad]))
+        w = jnp.asarray(
+            np.append(np.ones(len(idx)), np.zeros(pad)), jnp.float32
+        )
+
+        Hauto = HV.materialize_block_hessian(
+            model, params, u, i, rel_x, rel_y, w, 0.0
+        )
+        Hana = model.block_hessian(params, u, i, rel_x, rel_y, w)
+        np.testing.assert_allclose(Hana, Hauto, rtol=1e-4, atol=1e-5)
+
+        # fractional weights must enter each term exactly once
+        wf = w * jnp.asarray(
+            np.random.default_rng(1).uniform(0.3, 1.0, w.shape), jnp.float32
+        )
+        Hauto_f = HV.materialize_block_hessian(
+            model, params, u, i, rel_x, rel_y, wf, 0.0
+        )
+        Hana_f = model.block_hessian(params, u, i, rel_x, rel_y, wf)
+        np.testing.assert_allclose(Hana_f, Hauto_f, rtol=1e-4, atol=1e-5)
+
     def test_materialized_hessian_symmetric(self, model_cls):
         model, params, train = _setup(model_cls)
         u, i = 3, 5

@@ -52,6 +52,61 @@ class TestTorchParity:
         assert min(rhos) >= 0.99, (rhos, rs)
         assert min(rs) >= 0.99, (rhos, rs)
 
+    def test_ncf_scores_match_reference_impl(self, tiny_splits):
+        """NCF parity: the 4-embedding-row block (4k params, MLP weights
+        excluded) against the torch fmin_ncg reference engine. The NCF
+        prediction is piecewise-linear in the block for rows touching only
+        one of (u, i), so the related-set block Hessian is PSD+wd and the
+        solvers must agree."""
+        from fia_tpu.backends.torch_ref import TorchRefNCFEngine
+        from fia_tpu.models import NCF
+
+        train = tiny_splits["train"]
+        model = NCF(train.num_users, train.num_items, 4, WD)
+        params = model.init_params(jax.random.PRNGKey(1))
+        tr = Trainer(model, TrainConfig(batch_size=200, num_steps=800,
+                                        learning_rate=1e-2))
+        params = tr.fit(tr.init_state(params), train.x, train.y).params
+
+        host = jax.tree_util.tree_map(np.asarray, params)
+        ref = TorchRefNCFEngine(host, train.x, train.y, weight_decay=WD,
+                                damping=DAMP)
+        eng = InfluenceEngine(model, params, train, damping=DAMP,
+                              solver="direct")
+        train_pairs = set(map(tuple, train.x.tolist()))
+        pts = [tuple(p) for p in tiny_splits["test"].x
+               if tuple(p) not in train_pairs][:3]
+        assert pts, "test split fully collides with train pairs"
+        rhos, rs = [], []
+        for u, i in pts:
+            ref_scores, ref_rows = ref.query(int(u), int(i))
+            res = eng.query_batch(np.array([[u, i]]))
+            assert np.array_equal(res.related_of(0), ref_rows)
+            rhos.append(spearman(res.scores_of(0), ref_scores))
+            rs.append(pearson(res.scores_of(0), ref_scores))
+        assert min(rhos) >= 0.99, (rhos, rs)
+        assert min(rs) >= 0.99, (rhos, rs)
+
+    def test_ncf_test_vector_parity(self, tiny_splits):
+        from fia_tpu.backends.torch_ref import TorchRefNCFEngine
+        from fia_tpu.influence.grads import block_prediction_grad
+        from fia_tpu.models import NCF
+        import jax.numpy as jnp
+
+        train = tiny_splits["train"]
+        model = NCF(train.num_users, train.num_items, 4, WD)
+        params = model.init_params(jax.random.PRNGKey(2))
+        host = jax.tree_util.tree_map(np.asarray, params)
+        ref = TorchRefNCFEngine(host, train.x, train.y, weight_decay=WD,
+                                damping=DAMP)
+        u, i = 3, 5
+        v_jax = np.asarray(
+            block_prediction_grad(model, params, u, i,
+                                  jnp.array([[u, i]], jnp.int32))
+        )
+        np.testing.assert_allclose(v_jax, ref.test_vector(u, i),
+                                   rtol=1e-4, atol=1e-6)
+
     def test_test_vector_parity(self, trained_mf):
         model, params, train = trained_mf
         host = jax.tree_util.tree_map(np.asarray, params)
