@@ -64,19 +64,14 @@ def mf_influence_scores(
 ) -> jnp.ndarray:
     """(P,) influence scores for one test point's related rows."""
     P, k = qg.shape
+    # Default (VMEM, full-array, trivial-index) block specs: the whole
+    # padded gather fits VMEM comfortably (P<=a few thousand, k<=256),
+    # and — unlike memory_space=ANY — they batch legally when the engine
+    # vmaps this call over a query batch (Mosaic rejects ANY-space blocks
+    # with the non-trivial index maps vmap introduces).
     out = pl.pallas_call(
         _score_kernel,
         out_shape=jax.ShapeDtypeStruct((P, 1), jnp.float32),
-        in_specs=[
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
-        out_specs=pl.BlockSpec(memory_space=pl.ANY),
         interpret=interpret,
     )(
         qg.astype(jnp.float32),

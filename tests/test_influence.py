@@ -261,6 +261,24 @@ class TestEngine:
                 batched.scores_of(t), single.scores_of(0), rtol=1e-4, atol=1e-6
             )
 
+    def test_grouped_equals_ungrouped(self, model_cls):
+        """group_queries=True splits the batch by pad bucket; scores,
+        counts, and per-query ihvp must match the single-pad path."""
+        model, params, train = _setup(model_cls)
+        eng = InfluenceEngine(model, params, train, damping=DAMP, pad_bucket=16)
+        eng_g = InfluenceEngine(model, params, train, damping=DAMP,
+                                pad_bucket=16, group_queries=True)
+        pts = np.array([[3, 5], [7, 2], [1, 1], [0, 4]])
+        a = eng.query_batch(pts)
+        b = eng_g.query_batch(pts)
+        assert np.array_equal(a.counts, b.counts)
+        np.testing.assert_allclose(a.ihvp, b.ihvp, rtol=1e-4, atol=1e-6)
+        for t in range(len(pts)):
+            assert np.array_equal(a.related_of(t), b.related_of(t))
+            np.testing.assert_allclose(
+                a.scores_of(t), b.scores_of(t), rtol=1e-4, atol=1e-6
+            )
+
     def test_reference_wrapper_and_cache(self, model_cls, tmp_path):
         model, params, train = _setup(model_cls)
         test_ds = RatingDataset(np.array([[3, 5]], np.int32), np.array([4.0]))
