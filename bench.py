@@ -61,12 +61,12 @@ def main():
     _ensure_live_backend()
     import jax
 
-    from fia_tpu.backends.torch_ref import TorchRefMFEngine
+    from fia_tpu.backends.torch_ref import TorchRefMFEngine, TorchRefNCFEngine
     from fia_tpu.data.synthetic import synthesize_ratings
     from fia_tpu.eval.metrics import spearman
     from fia_tpu.eval.rq2 import time_influence_queries
     from fia_tpu.influence.engine import InfluenceEngine
-    from fia_tpu.models import MF
+    from fia_tpu.models import MF, NCF
     from fia_tpu.train.trainer import Trainer, TrainConfig
 
     # Training length matters beyond MAE: the influence solvers only
@@ -135,6 +135,30 @@ def main():
     base_scores_per_sec = base_scores_total / base_time
     vs_baseline = timing.scores_per_sec / base_scores_per_sec
 
+    # --- NCF stage (BASELINE.json configs 3/4): timing + parity ---------
+    ncf_steps = 800 if QUICK else 12_000
+    ncf_q = min(n_queries, 128)
+    _stage(f"NCF stage: {ncf_steps} train steps")
+    ncf = NCF(users, items, k, wd)
+    tr_n = Trainer(ncf, TrainConfig(batch_size=batch, num_steps=ncf_steps,
+                                    learning_rate=lr))
+    ncf_state = tr_n.fit(tr_n.init_state(ncf.init_params(jax.random.PRNGKey(1))),
+                         train.x, train.y)
+    ncf_engine = InfluenceEngine(ncf, ncf_state.params, train,
+                                 damping=damping, solver="direct",
+                                 pad_bucket=512, model_name="ncf")
+    _stage(f"NCF stage: timing {ncf_q} queries")
+    ncf_timing = time_influence_queries(ncf_engine, points[:ncf_q], repeats=3)
+    ncf_host = jax.tree_util.tree_map(np.asarray, ncf_state.params)
+    ncf_ref = TorchRefNCFEngine(ncf_host, train.x, train.y,
+                                weight_decay=wd, damping=damping)
+    ncf_res = ncf_engine.query_batch(points[:n_base])
+    ncf_rhos = []
+    for t in range(n_base):
+        ref_scores, _ = ncf_ref.query(int(points[t, 0]), int(points[t, 1]))
+        ncf_rhos.append(spearman(ncf_res.scores_of(t), ref_scores))
+    _stage(f"NCF stage done ({ncf_timing.scores_per_sec:.0f} scores/s)")
+
     out = {
         "metric": "fia-influence-scores/sec (MF k=16, ML-1M scale)",
         "value": round(timing.scores_per_sec, 1),
@@ -150,6 +174,13 @@ def main():
             "cpu_ref_scores_per_sec": round(base_scores_per_sec, 1),
             "spearman_vs_cpu_ref_min": round(float(min(rhos)), 4),
             "train_steps": steps,
+            "ncf": {
+                "scores_per_sec": round(ncf_timing.scores_per_sec, 1),
+                "queries_per_sec": round(ncf_timing.queries_per_sec, 2),
+                "per_query_ms": round(ncf_timing.per_query_ms, 3),
+                "spearman_vs_cpu_ref_min": round(float(min(ncf_rhos)), 4),
+                "train_steps": ncf_steps,
+            },
         },
     }
     print(json.dumps(out))

@@ -1,4 +1,8 @@
-from fia_tpu.data.dataset import RatingDataset  # noqa: F401
+from fia_tpu.data.dataset import (  # noqa: F401
+    RatingDataset,
+    filter_dataset,
+    find_distances,
+)
 from fia_tpu.data.loaders import load_movielens, load_yelp, load_dataset  # noqa: F401
 from fia_tpu.data.synthetic import synthesize_ratings  # noqa: F401
 from fia_tpu.data.index import InteractionIndex  # noqa: F401

@@ -114,3 +114,48 @@ class RatingDataset:
     def subset(self, indices) -> "RatingDataset":
         idx = np.asarray(indices)
         return RatingDataset(self.x[idx], self.y[idx])
+
+
+# -- module-level utilities (reference dataset.py:73-103) -------------------
+def filter_dataset(
+    x: np.ndarray, y: np.ndarray, pos_class, neg_class
+) -> tuple[np.ndarray, np.ndarray]:
+    """Restrict (x, y) to two label classes and relabel them ±1.
+
+    Capability parity with the reference's module-level ``filter_dataset``
+    (``src/influence/dataset.py:73-90``): rows whose label is neither
+    ``pos_class`` nor ``neg_class`` are dropped; surviving labels map to
+    +1 (pos) / -1 (neg). Unused by the rating workload (ratings are
+    regression targets) but part of the dataset module's public surface.
+    """
+    x = np.asarray(x)
+    y = np.asarray(y).astype(int)
+    if x.shape[0] != y.shape[0] or y.ndim != 1:
+        raise ValueError("x and y must align on N and y must be 1-D")
+    pos = y == pos_class
+    neg = y == neg_class
+    keep = pos | neg
+    out_y = np.where(pos, 1, -1)[keep]
+    return x[keep], out_y
+
+
+def find_distances(
+    target: np.ndarray, x: np.ndarray, theta: np.ndarray | None = None
+) -> np.ndarray:
+    """Per-row distance from ``target``: L2, or |projection onto theta|.
+
+    Parity with the reference's ``find_distances``
+    (``src/influence/dataset.py:93-105``).
+    """
+    x = np.asarray(x)
+    if x.ndim != 2:
+        raise ValueError(f"x must be 2-D, got ndim={x.ndim}")
+    target = np.asarray(target).reshape(-1)
+    if x.shape[1] != target.shape[0]:
+        raise ValueError(
+            f"feature dims differ: x has {x.shape[1]}, target {target.shape[0]}"
+        )
+    diff = x - target
+    if theta is None:
+        return np.linalg.norm(diff, axis=1)
+    return np.abs(diff @ np.asarray(theta).reshape(-1))

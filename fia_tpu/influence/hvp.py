@@ -12,6 +12,7 @@ graph surgery — over the functionally-substituted block.
 from __future__ import annotations
 
 import jax
+import jax.flatten_util
 import jax.numpy as jnp
 
 
@@ -49,6 +50,27 @@ def materialize_block_hessian(model, params, u, i, x, y, w, damping: float):
     hvp = make_block_hvp(model, params, u, i, x, y, w, damping)
     d = model.block_size
     return jax.vmap(hvp)(jnp.eye(d, dtype=jnp.float32))
+
+
+def materialize_full_hessian(model, params, x, y, w=None, damping: float = 0.0):
+    """Dense Hessian of the total loss over ALL parameters, shape (D, D).
+
+    Working equivalent of the reference's dead ``hessians.hessians``
+    (``src/influence/hessians.py:125-181`` — broken: uses the removed
+    ``array_ops.unpack/pack``). Rows/columns follow
+    ``jax.flatten_util.ravel_pytree`` order over the parameter pytree.
+    Only sensible for small D — used by tests to validate HVPs against
+    an explicit Hessian.
+    """
+    flat0, unravel = jax.flatten_util.ravel_pytree(params)
+
+    def total(flat):
+        return model.loss(unravel(flat), x, y, w)
+
+    Hmat = jax.hessian(total)(flat0)
+    if damping:
+        Hmat = Hmat + damping * jnp.eye(flat0.shape[0], dtype=Hmat.dtype)
+    return Hmat
 
 
 def make_full_hvp(model, params, x, y, w=None, damping: float = 0.0):

@@ -19,6 +19,7 @@ mode on CPU).
 from __future__ import annotations
 
 import functools
+import math
 
 import jax
 import jax.numpy as jnp
@@ -64,13 +65,29 @@ def mf_influence_scores(
 ) -> jnp.ndarray:
     """(P,) influence scores for one test point's related rows."""
     P, k = qg.shape
-    # Default (VMEM, full-array, trivial-index) block specs: the whole
-    # padded gather fits VMEM comfortably (P<=a few thousand, k<=256),
-    # and — unlike memory_space=ANY — they batch legally when the engine
-    # vmaps this call over a query batch (Mosaic rejects ANY-space blocks
-    # with the non-trivial index maps vmap introduces).
+    # Grid over row tiles: when the engine vmaps this call over a query
+    # batch, Mosaic batches by extending the grid, and scoped VMEM must
+    # hold only one (tile, k) block per operand — not the whole
+    # (T, P, k) gather (a 256-query batch at P=3584 otherwise overflows
+    # the 16M scoped-vmem limit). gcd(P, 512) always divides P, so the
+    # tile never silently falls back to whole-array blocking; odd pad
+    # buckets just get smaller tiles.
+    tile = math.gcd(P, 512)
+    row = lambda p: (p, 0)
+    rep = lambda p: (0, 0)
     out = pl.pallas_call(
         _score_kernel,
+        grid=(P // tile,),
+        in_specs=[
+            pl.BlockSpec((tile, k), row),
+            pl.BlockSpec((tile, k), row),
+            pl.BlockSpec((tile, 1), row),
+            pl.BlockSpec((tile, 1), row),
+            pl.BlockSpec((tile, 1), row),
+            pl.BlockSpec((1, 2 * k + 2), rep),
+            pl.BlockSpec((1, 1), rep),
+        ],
+        out_specs=pl.BlockSpec((tile, 1), row),
         out_shape=jax.ShapeDtypeStruct((P, 1), jnp.float32),
         interpret=interpret,
     )(

@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""MovieLens-20M-scale stress config (BASELINE.json config 5).
+
+The reference never ran beyond ML-1M/Yelp (single GPU, replicated
+tables). This driver exercises the framework at ML-20M scale — 138,493
+users, 26,744 items, 20,000,263 train rows (the real ML-20M marginals) —
+with the embedding tables optionally row-sharded over the 'model' axis
+of a 2-D ('data', 'model') mesh (``fia_tpu/parallel/sharded.py``), the
+regime where one device's HBM no longer holds the tables at large k.
+
+Train split is synthesized (the reference's train blobs are stripped
+upstream, ref:.MISSING_LARGE_BLOBS:1-2) with the same heavy-tailed
+marginals the FIA related-set sizes depend on.
+
+Prints one JSON line: training step time, influence queries/sec and
+scores/sec at the stress scale.
+
+Usage:
+  python scripts/stress.py                  # full ML-20M scale (TPU)
+  python scripts/stress.py --smoke          # tiny shapes, CPU-safe
+  python scripts/stress.py --model_parallel 2 --embed_size 64
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+# The axon (tunneled-TPU) image re-selects its platform via jax.config at
+# interpreter start, overriding JAX_PLATFORMS; honor an explicit CPU ask.
+if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny shapes for CI / CPU")
+    ap.add_argument("--embed_size", type=int, default=16)
+    ap.add_argument("--train_steps", type=int, default=2000)
+    ap.add_argument("--num_queries", type=int, default=256)
+    ap.add_argument("--model_parallel", type=int, default=1,
+                    help=">1 row-shards the embedding tables over a "
+                         "'model' mesh axis (needs that many devices)")
+    ap.add_argument("--batch_size", type=int, default=8192)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    import jax
+
+    from fia_tpu.data.synthetic import synthesize_ratings
+    from fia_tpu.eval.rq2 import time_influence_queries
+    from fia_tpu.influence.engine import InfluenceEngine
+    from fia_tpu.models import MF
+    from fia_tpu.parallel.sharded import make_2d_mesh
+    from fia_tpu.train.trainer import Trainer, TrainConfig
+
+    if args.smoke:
+        users, items, rows = 600, 300, 30_000
+        steps = min(args.train_steps, 200)
+        n_q = min(args.num_queries, 16)
+        batch = 1024
+    else:
+        users, items, rows = 138_493, 26_744, 20_000_263  # ML-20M stats
+        steps, n_q, batch = args.train_steps, args.num_queries, args.batch_size
+
+    k = args.embed_size
+    print(f"stress: {users} users x {items} items, {rows} rows, k={k}, "
+          f"backend={jax.default_backend()} devices={jax.device_count()}",
+          file=sys.stderr, flush=True)
+
+    t0 = time.perf_counter()
+    train = synthesize_ratings(users, items, rows, seed=args.seed)
+    gen_s = time.perf_counter() - t0
+    print(f"stress: synthesized in {gen_s:.1f}s", file=sys.stderr, flush=True)
+
+    model = MF(users, items, k, weight_decay=1e-3)
+    params = model.init_params(jax.random.PRNGKey(args.seed))
+
+    mesh = None
+    shard_tables = False
+    if args.model_parallel > 1:
+        if jax.device_count() % args.model_parallel:
+            raise SystemExit(
+                f"--model_parallel {args.model_parallel} does not divide "
+                f"device count {jax.device_count()}"
+            )
+        mesh = make_2d_mesh(model_parallel=args.model_parallel)
+        shard_tables = True
+
+    tr = Trainer(model, TrainConfig(batch_size=batch, num_steps=steps,
+                                    learning_rate=1e-2))
+    t0 = time.perf_counter()
+    state = tr.fit(tr.init_state(params), train.x, train.y)
+    train_s = time.perf_counter() - t0
+    step_ms = 1e3 * train_s / max(steps, 1)
+    print(f"stress: {steps} train steps in {train_s:.1f}s "
+          f"({step_ms:.2f} ms/step)", file=sys.stderr, flush=True)
+
+    engine = InfluenceEngine(
+        model, state.params, train, damping=1e-6, solver="direct",
+        pad_bucket=512, mesh=mesh, shard_tables=shard_tables,
+    )
+
+    # Held-out query points, same protocol as bench.py: a pair present in
+    # train couples its p_u/q_i blocks and can make the related-set block
+    # Hessian indefinite — a regime the reference never queries. Membership
+    # is checked against ALL rows via packed (u * items + i) codes (a
+    # tuple set over 20M rows would cost GBs).
+    rng = np.random.default_rng(17)
+    codes = np.sort(train.x[:, 0].astype(np.int64) * items + train.x[:, 1])
+    pts = []
+    while len(pts) < n_q:
+        u, i = int(rng.integers(0, users)), int(rng.integers(0, items))
+        c = u * items + i
+        j = np.searchsorted(codes, c)
+        if j == len(codes) or codes[j] != c:
+            pts.append((u, i))
+    points = np.asarray(pts, dtype=np.int32)
+
+    timing = time_influence_queries(engine, points, repeats=3)
+    out = {
+        "metric": f"stress-ml20m-scale influence (MF k={k})",
+        "value": round(timing.scores_per_sec, 1),
+        "unit": "scores/sec",
+        "details": {
+            "backend": jax.default_backend(),
+            "devices": jax.device_count(),
+            "model_parallel": args.model_parallel,
+            "users": users, "items": items, "train_rows": rows,
+            "train_step_ms": round(step_ms, 3),
+            "queries_per_sec": round(timing.queries_per_sec, 2),
+            "per_query_ms": round(timing.per_query_ms, 3),
+            "compile_s": round(timing.compile_time_s, 2),
+            "num_queries": timing.num_queries,
+            "num_scores": timing.num_scores,
+        },
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,7 +1,7 @@
 import numpy as np
 import pytest
 
-from fia_tpu.data.dataset import RatingDataset
+from fia_tpu.data.dataset import RatingDataset, filter_dataset, find_distances
 from fia_tpu.data.index import InteractionIndex
 from fia_tpu.data.synthetic import synthesize_ratings
 
@@ -61,6 +61,39 @@ class TestRatingDataset:
     def test_mismatched_lengths_raise(self):
         with pytest.raises(ValueError):
             RatingDataset(np.zeros((3, 2)), np.zeros(4))
+
+
+class TestModuleUtils:
+    """Parity with the reference's module-level dataset utilities
+    (``ref:src/influence/dataset.py:73-105``)."""
+
+    def test_filter_dataset_relabels_and_drops(self):
+        x = np.arange(12).reshape(6, 2)
+        y = np.array([0, 1, 2, 1, 0, 3])
+        fx, fy = filter_dataset(x, y, pos_class=1, neg_class=0)
+        np.testing.assert_array_equal(fx, x[[0, 1, 3, 4]])
+        np.testing.assert_array_equal(fy, [-1, 1, 1, -1])
+
+    def test_filter_dataset_validates(self):
+        with pytest.raises(ValueError):
+            filter_dataset(np.zeros((3, 2)), np.zeros(4), 1, 0)
+
+    def test_find_distances_l2(self):
+        x = np.array([[0.0, 0.0], [3.0, 4.0]])
+        d = find_distances(np.array([0.0, 0.0]), x)
+        np.testing.assert_allclose(d, [0.0, 5.0])
+
+    def test_find_distances_projection(self):
+        x = np.array([[1.0, 1.0], [2.0, -1.0]])
+        target = np.array([0.0, 0.0])
+        theta = np.array([1.0, 0.0])
+        np.testing.assert_allclose(find_distances(target, x, theta), [1.0, 2.0])
+
+    def test_find_distances_validates(self):
+        with pytest.raises(ValueError):
+            find_distances(np.zeros(3), np.zeros((2, 2)))
+        with pytest.raises(ValueError):
+            find_distances(np.zeros(2), np.zeros((2, 2, 2)))
 
 
 class TestInteractionIndex:

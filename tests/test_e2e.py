@@ -80,6 +80,28 @@ class TestCLI:
         ])
         assert timing.num_queries == 4
 
+    def test_stress_driver_smoke(self):
+        """scripts/stress.py (ML-20M stress config, BASELINE.json config 5)
+        runs end-to-end with table sharding on the virtual mesh."""
+        import json
+        import os
+        import subprocess
+        import sys
+
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        # conftest.py already forces JAX_PLATFORMS=cpu and the 8-device
+        # virtual mesh into os.environ; the subprocess inherits both.
+        env = dict(os.environ)
+        out = subprocess.run(
+            [sys.executable, os.path.join(root, "scripts", "stress.py"),
+             "--smoke", "--model_parallel", "2"],
+            capture_output=True, text=True, timeout=300, env=env, cwd=root,
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        res = json.loads(out.stdout.strip().splitlines()[-1])
+        assert res["details"]["model_parallel"] == 2
+        assert res["value"] > 0
+
     def test_rq1_cli_runs(self, tmp_path):
         from fia_tpu.cli import rq1
 

@@ -6,6 +6,7 @@ import numpy as np
 from jax.flatten_util import ravel_pytree
 
 from fia_tpu.data.dataset import RatingDataset
+from fia_tpu.influence import hvp as HV
 from fia_tpu.influence.full import FullInfluenceEngine
 from fia_tpu.models import MF
 
@@ -53,6 +54,28 @@ def _dense_solution(model, params, train, test_x, test_y, damp):
         return jnp.dot(g, ihvp)
 
     return np.asarray(jax.jit(jax.vmap(per_row))(x, y)) / train.num_examples
+
+
+class TestFullHessian:
+    def test_materialized_full_hessian_matches_hvp_and_is_symmetric(self):
+        """materialize_full_hessian (working stand-in for the reference's
+        dead ``hessians.hessians``, ref:src/influence/hessians.py:125-181)
+        agrees with the matrix-free full HVP."""
+        model, params, train = _setup()
+        x, y = jnp.asarray(train.x), jnp.asarray(train.y)
+        damp = 1e-2
+        H = HV.materialize_full_hessian(model, params, x, y, damping=damp)
+        flat0, unravel = ravel_pytree(params)
+        D = flat0.shape[0]
+        assert H.shape == (D, D)
+        np.testing.assert_allclose(H, H.T, atol=1e-5)
+
+        hvp = HV.make_full_hvp(model, params, x, y, damping=damp)
+        rng = np.random.default_rng(0)
+        v_flat = jnp.asarray(rng.standard_normal(D), jnp.float32)
+        hv_tree = hvp(unravel(v_flat))
+        hv_flat, _ = ravel_pytree(hv_tree)
+        np.testing.assert_allclose(H @ v_flat, hv_flat, rtol=1e-4, atol=1e-5)
 
 
 class TestFullEngine:
