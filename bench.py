@@ -62,7 +62,7 @@ def main():
     import jax
 
     from fia_tpu.backends.torch_ref import TorchRefMFEngine, TorchRefNCFEngine
-    from fia_tpu.data.synthetic import synthesize_ratings
+    from fia_tpu.data.synthetic import sample_heldout_pairs, synthesize_ratings
     from fia_tpu.eval.metrics import spearman
     from fia_tpu.eval.rq2 import time_influence_queries
     from fia_tpu.influence.engine import InfluenceEngine
@@ -98,18 +98,9 @@ def main():
 
     engine = InfluenceEngine(model, params, train, damping=damping,
                              solver="direct", pad_bucket=512)
-    # Query points are held-out (u, i) pairs, as in the reference's RQ1/RQ2
-    # (test split is disjoint from train). A pair present in train couples
-    # the p_u/q_i blocks through its residual and can make the related-set
-    # block Hessian indefinite — a regime the reference never queries.
-    rng = np.random.default_rng(17)
-    train_pairs = set(map(tuple, train.x.tolist()))
-    pts = []
-    while len(pts) < n_queries:
-        u, i = int(rng.integers(0, users)), int(rng.integers(0, items))
-        if (u, i) not in train_pairs:
-            pts.append((u, i))
-    points = np.asarray(pts, dtype=np.int32)
+    # Held-out (u, i) query pairs, as in the reference's RQ1/RQ2 (test
+    # split disjoint from train) — see sample_heldout_pairs.
+    points = sample_heldout_pairs(train.x, users, items, n_queries, seed=17)
 
     _stage(f"timing {n_queries} influence queries")
     timing = time_influence_queries(engine, points, repeats=3)
@@ -136,28 +127,41 @@ def main():
     vs_baseline = timing.scores_per_sec / base_scores_per_sec
 
     # --- NCF stage (BASELINE.json configs 3/4): timing + parity ---------
+    # Failure here (OOM, tunnel drop) must not discard the completed MF
+    # measurements above — degrade to an "error" entry instead.
     ncf_steps = 800 if QUICK else 12_000
-    ncf_q = min(n_queries, 128)
-    _stage(f"NCF stage: {ncf_steps} train steps")
-    ncf = NCF(users, items, k, wd)
-    tr_n = Trainer(ncf, TrainConfig(batch_size=batch, num_steps=ncf_steps,
-                                    learning_rate=lr))
-    ncf_state = tr_n.fit(tr_n.init_state(ncf.init_params(jax.random.PRNGKey(1))),
-                         train.x, train.y)
-    ncf_engine = InfluenceEngine(ncf, ncf_state.params, train,
-                                 damping=damping, solver="direct",
-                                 pad_bucket=512, model_name="ncf")
-    _stage(f"NCF stage: timing {ncf_q} queries")
-    ncf_timing = time_influence_queries(ncf_engine, points[:ncf_q], repeats=3)
-    ncf_host = jax.tree_util.tree_map(np.asarray, ncf_state.params)
-    ncf_ref = TorchRefNCFEngine(ncf_host, train.x, train.y,
-                                weight_decay=wd, damping=damping)
-    ncf_res = ncf_engine.query_batch(points[:n_base])
-    ncf_rhos = []
-    for t in range(n_base):
-        ref_scores, _ = ncf_ref.query(int(points[t, 0]), int(points[t, 1]))
-        ncf_rhos.append(spearman(ncf_res.scores_of(t), ref_scores))
-    _stage(f"NCF stage done ({ncf_timing.scores_per_sec:.0f} scores/s)")
+    try:
+        ncf_q = min(n_queries, 128)
+        _stage(f"NCF stage: {ncf_steps} train steps")
+        ncf = NCF(users, items, k, wd)
+        tr_n = Trainer(ncf, TrainConfig(batch_size=batch, num_steps=ncf_steps,
+                                        learning_rate=lr))
+        ncf_state = tr_n.fit(tr_n.init_state(ncf.init_params(jax.random.PRNGKey(1))),
+                             train.x, train.y)
+        ncf_engine = InfluenceEngine(ncf, ncf_state.params, train,
+                                     damping=damping, solver="direct",
+                                     pad_bucket=512, model_name="ncf")
+        _stage(f"NCF stage: timing {ncf_q} queries")
+        ncf_timing = time_influence_queries(ncf_engine, points[:ncf_q], repeats=3)
+        ncf_host = jax.tree_util.tree_map(np.asarray, ncf_state.params)
+        ncf_ref = TorchRefNCFEngine(ncf_host, train.x, train.y,
+                                    weight_decay=wd, damping=damping)
+        ncf_res = ncf_engine.query_batch(points[:n_base])
+        ncf_rhos = []
+        for t in range(n_base):
+            ref_scores, _ = ncf_ref.query(int(points[t, 0]), int(points[t, 1]))
+            ncf_rhos.append(spearman(ncf_res.scores_of(t), ref_scores))
+        _stage(f"NCF stage done ({ncf_timing.scores_per_sec:.0f} scores/s)")
+        ncf_out = {
+            "scores_per_sec": round(ncf_timing.scores_per_sec, 1),
+            "queries_per_sec": round(ncf_timing.queries_per_sec, 2),
+            "per_query_ms": round(ncf_timing.per_query_ms, 3),
+            "spearman_vs_cpu_ref_min": round(float(min(ncf_rhos)), 4),
+            "train_steps": ncf_steps,
+        }
+    except Exception as e:  # noqa: BLE001 — report, don't lose MF results
+        _stage(f"NCF stage FAILED: {e!r}")
+        ncf_out = {"error": repr(e), "train_steps": ncf_steps}
 
     out = {
         "metric": "fia-influence-scores/sec (MF k=16, ML-1M scale)",
@@ -174,13 +178,7 @@ def main():
             "cpu_ref_scores_per_sec": round(base_scores_per_sec, 1),
             "spearman_vs_cpu_ref_min": round(float(min(rhos)), 4),
             "train_steps": steps,
-            "ncf": {
-                "scores_per_sec": round(ncf_timing.scores_per_sec, 1),
-                "queries_per_sec": round(ncf_timing.queries_per_sec, 2),
-                "per_query_ms": round(ncf_timing.per_query_ms, 3),
-                "spearman_vs_cpu_ref_min": round(float(min(ncf_rhos)), 4),
-                "train_steps": ncf_steps,
-            },
+            "ncf": ncf_out,
         },
     }
     print(json.dumps(out))

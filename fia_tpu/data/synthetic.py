@@ -73,6 +73,42 @@ def synthesize_ratings(
     return RatingDataset(x, ratings)
 
 
+def sample_heldout_pairs(
+    train_x: np.ndarray,
+    num_users: int,
+    num_items: int,
+    n: int,
+    seed: int = 17,
+) -> np.ndarray:
+    """Sample ``n`` distinct (u, i) pairs absent from the training set.
+
+    The benchmark/stress query protocol (mirroring the reference's RQ1/
+    RQ2, whose test split is disjoint from train): a pair present in
+    train couples its p_u/q_i blocks through the shared residual and can
+    make the related-set block Hessian indefinite — a regime the
+    reference never queries. Membership is tested against packed
+    ``u * num_items + i`` codes so it stays cheap at ML-20M scale (a
+    tuple set over 20M rows costs GBs).
+    """
+    rng = np.random.default_rng(seed)
+    codes = np.sort(
+        np.asarray(train_x[:, 0], np.int64) * num_items
+        + np.asarray(train_x[:, 1], np.int64)
+    )
+    picked: set[int] = set()
+    pts: list[tuple[int, int]] = []
+    while len(pts) < n:
+        u, i = int(rng.integers(0, num_users)), int(rng.integers(0, num_items))
+        c = u * num_items + i
+        if c in picked:
+            continue
+        j = np.searchsorted(codes, c)
+        if j == len(codes) or codes[j] != c:
+            picked.add(c)
+            pts.append((u, i))
+    return np.asarray(pts, dtype=np.int32)
+
+
 def synthetic_splits(
     num_users: int,
     num_items: int,

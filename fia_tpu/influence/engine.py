@@ -22,7 +22,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from fia_tpu.data.dataset import RatingDataset
 from fia_tpu.data.index import InteractionIndex
@@ -101,6 +101,19 @@ class InfluenceEngine:
             self.params = shard_model_params(mesh, self.params, model)
         self.train_x = jnp.asarray(train.x)
         self.train_y = jnp.asarray(train.y)
+        self._multihost = False
+        if mesh is not None:
+            # On a cross-process (multi-host) mesh every jit operand must
+            # be a global array; params (unless already table-sharded
+            # above) and train tensors are replicated. No-op single-host.
+            from fia_tpu.parallel.distributed import put_global, spans_processes
+
+            if spans_processes(mesh):
+                self._multihost = True
+                if not shard_tables:
+                    self.params = put_global(mesh, self.params, P())
+                self.train_x = put_global(mesh, self.train_x, P())
+                self.train_y = put_global(mesh, self.train_y, P())
         self.index = InteractionIndex(train.x, model.num_users, model.num_items)
         self.damping = float(damping)
         self.solver = solver
@@ -280,7 +293,8 @@ class InfluenceEngine:
         rmask = jnp.asarray(rel_mask)
 
         if self.mesh is not None:
-            spec = NamedSharding(self.mesh, P("data"))
+            from fia_tpu.parallel.distributed import put_global
+
             n = self.mesh.devices.size
             T = test_points.shape[0]
             pad_T = (-T) % n
@@ -291,12 +305,21 @@ class InfluenceEngine:
                 ridx = jnp.concatenate([ridx, jnp.repeat(ridx[-1:], pad_T, axis=0)])
                 rmask = jnp.concatenate([rmask, jnp.repeat(rmask[-1:], pad_T, axis=0)])
             u, i, tx, ridx, rmask = (
-                jax.device_put(a, spec) for a in (u, i, tx, ridx, rmask)
+                put_global(self.mesh, a, P("data", *([None] * (a.ndim - 1))))
+                for a in (u, i, tx, ridx, rmask)
             )
 
         scores, ihvp, v = self._batched(pad)(
             self.params, self.train_x, self.train_y, u, i, tx, ridx, rmask
         )
+        if self._multihost:
+            # Data-sharded outputs span non-addressable devices; gather
+            # every process a full host copy before np.asarray below.
+            from jax.experimental import multihost_utils
+
+            scores, ihvp, v = multihost_utils.process_allgather(
+                (scores, ihvp, v), tiled=True
+            )
         T = test_points.shape[0]
         return InfluenceResult(
             scores=np.asarray(scores)[:T],

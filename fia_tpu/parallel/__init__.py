@@ -1,1 +1,8 @@
 from fia_tpu.parallel.mesh import make_mesh, shard_along, replicate  # noqa: F401
+from fia_tpu.parallel.distributed import (  # noqa: F401
+    initialize,
+    runtime_info,
+    make_hybrid_mesh,
+    global_batch,
+    process_local_rows,
+)

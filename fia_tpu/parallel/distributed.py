@@ -1,0 +1,227 @@
+"""Multi-host (multi-process) distributed runtime.
+
+The reference is strictly single-process (one ``tf.Session``, one pinned
+device — SURVEY.md §2.4: no NCCL/MPI/horovod anywhere). The TPU-native
+counterpart of "a communication backend that scales out" is *not* an
+explicit message-passing layer: processes join one JAX runtime, devices
+form a global :class:`~jax.sharding.Mesh`, and XLA inserts the
+collectives — riding ICI within a slice and DCN across slices/hosts.
+
+This module holds the pieces of that story that are about *processes*
+rather than devices:
+
+  - :func:`initialize` — join the multi-process runtime (coordinator
+    handshake), idempotent, no-op for single-process runs.
+  - :func:`runtime_info` — process/device topology snapshot.
+  - :func:`make_hybrid_mesh` — a ('data', 'model') mesh laid out so the
+    'model' axis (embedding-table row sharding; heavy gather/psum
+    traffic) stays within a host/slice on ICI, while the 'data' axis
+    (query batches / train shards; one psum per step) spans hosts over
+    DCN — the standard hybrid layout, cf. scaling-book recipe.
+  - :func:`global_batch` — assemble per-process host arrays into one
+    global sharded array (each process feeds only its local rows).
+  - :func:`process_local_rows` — which slice of a global batch this
+    process should load, so data loading scales with host count.
+
+Everything degrades gracefully to single-process: the unit suite runs the
+same code paths on the virtual 8-device CPU mesh, and a real multi-host
+job only adds ``initialize(coordinator_address=...)`` up front.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+_initialized = False
+
+
+def initialize(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+    local_device_ids=None,
+) -> None:
+    """Join the multi-process JAX runtime (the distributed "backend").
+
+    Wraps :func:`jax.distributed.initialize`: every process dials the
+    coordinator, after which ``jax.devices()`` is the *global* device
+    list and jitted collectives span hosts (DCN) transparently.
+
+    Single-process runs (no coordinator address, no auto-detectable
+    cluster) are a no-op, so drivers can call this unconditionally.
+    Idempotent across repeated calls.
+    """
+    global _initialized
+    if _initialized:
+        return
+    if coordinator_address is None and num_processes is None:
+        # No explicit cluster — stay single-process. (On managed TPU
+        # pods jax.distributed.initialize() can auto-detect the cluster;
+        # callers opt in by passing the coordinator explicitly so dev
+        # boxes never block on a handshake.)
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids,
+    )
+    _initialized = True
+
+
+@dataclass(frozen=True)
+class RuntimeInfo:
+    process_index: int
+    process_count: int
+    local_device_count: int
+    global_device_count: int
+    platform: str
+
+    @property
+    def is_multi_host(self) -> bool:
+        return self.process_count > 1
+
+
+def runtime_info() -> RuntimeInfo:
+    return RuntimeInfo(
+        process_index=jax.process_index(),
+        process_count=jax.process_count(),
+        local_device_count=jax.local_device_count(),
+        global_device_count=jax.device_count(),
+        platform=jax.default_backend(),
+    )
+
+
+def _granules(devs) -> list[list]:
+    """Group devices into ICI granules (slices/hosts), DCN between them.
+
+    TPU devices carry ``slice_index`` (multi-slice) — fall back to
+    ``process_index`` (multi-host CPU/GPU), then to one granule
+    (single-process dev box, virtual CPU mesh included).
+    """
+    for attr in ("slice_index", "process_index"):
+        keys = {getattr(d, attr, None) for d in devs}
+        if len(keys) > 1:
+            by = {}
+            for d in devs:
+                by.setdefault(getattr(d, attr), []).append(d)
+            return [by[k] for k in sorted(by)]
+    return [list(devs)]
+
+
+def make_hybrid_mesh(
+    model_parallel: int = 1,
+    axis_names: tuple[str, str] = ("data", "model"),
+    devices=None,
+    granules: list[list] | None = None,
+) -> Mesh:
+    """('data', 'model') mesh with DCN-aware axis placement.
+
+    The 'model' axis (all-gathers of sharded embedding rows on every
+    query — bandwidth-hungry) is laid out *within* an ICI granule
+    (host/slice); the 'data' axis (one gradient/HVP psum per step)
+    stacks granules, so only it crosses DCN. Same row-major layout
+    ``mesh_utils.create_hybrid_device_mesh`` would produce for a
+    (data, model) × (granules, 1) hybrid, built directly so it also
+    works on device kinds without ``slice_index`` and is testable on
+    the virtual CPU mesh (``granules`` override).
+
+    Single-granule runs degrade to a plain local reshape. ``model_parallel``
+    must divide the *per-granule* device count (a global-count check is
+    not enough: 2 hosts x 2 devices cannot host model_parallel=4 without
+    crossing DCN) — raises ``ValueError`` otherwise rather than silently
+    unsharding the tables.
+    """
+    devs = list(jax.devices()) if devices is None else list(devices)
+    groups = _granules(devs) if granules is None else [list(g) for g in granules]
+    sizes = {len(g) for g in groups}
+    if len(sizes) != 1:
+        raise ValueError(f"granules must be equal-sized, got sizes {sorted(sizes)}")
+    per = sizes.pop()
+    if per % model_parallel:
+        raise ValueError(
+            f"model_parallel={model_parallel} does not divide the "
+            f"per-granule device count {per}"
+        )
+    dev_arr = np.concatenate(
+        [np.asarray(g, dtype=object).reshape(per // model_parallel, model_parallel)
+         for g in groups],
+        axis=0,
+    )
+    return Mesh(dev_arr, axis_names)
+
+
+def process_local_rows(n_global: int) -> slice:
+    """The contiguous row range of a global batch this process feeds.
+
+    Rows are split as evenly as possible over processes (first
+    ``n_global % process_count`` processes take one extra row), covering
+    ``[0, n_global)`` exactly across all processes.
+    """
+    p, np_ = jax.process_index(), jax.process_count()
+    base, extra = divmod(n_global, np_)
+    start = p * base + min(p, extra)
+    return slice(start, start + base + (1 if p < extra else 0))
+
+
+def global_batch(
+    mesh: Mesh, local_rows, axis: str = "data", global_rows: int | None = None
+):
+    """Assemble per-process host rows into one global sharded array.
+
+    Each process passes only the rows :func:`process_local_rows` told it
+    to load; :func:`jax.make_array_from_process_local_data` stitches the
+    shards into a global array sharded along ``axis`` without any
+    host-side all-gather. Works unchanged (and is the identity layout)
+    for single-process runs.
+
+    ``global_rows`` must be passed when the row count does not divide
+    evenly over processes: without it each process infers the global
+    shape by scaling its own local shape, and uneven
+    :func:`process_local_rows` splits would disagree across processes.
+
+    Accepts an array or a pytree of arrays sharing the leading dimension.
+    """
+
+    def put(x):
+        x = np.asarray(x)
+        spec = [None] * x.ndim
+        spec[0] = axis
+        sharding = NamedSharding(mesh, P(*spec))
+        gshape = None if global_rows is None else (global_rows, *x.shape[1:])
+        return jax.make_array_from_process_local_data(sharding, x, gshape)
+
+    return jax.tree_util.tree_map(put, local_rows)
+
+
+def spans_processes(mesh: Mesh) -> bool:
+    """True when the mesh contains devices of more than one process."""
+    return len({d.process_index for d in mesh.devices.flat}) > 1
+
+
+def put_global(mesh: Mesh, tree, spec: P):
+    """Place host arrays (identical on every process) onto a mesh sharding.
+
+    Single-process (or local-only mesh): plain :func:`jax.device_put`.
+    Multi-process: :func:`jax.make_array_from_callback` — each process
+    serves only the index ranges its addressable devices own, which is
+    the supported way to build an array over non-addressable devices
+    (``device_put`` of host data onto a cross-process sharding is not).
+    Every process must hold the same full host array (the replicated-
+    input pattern: params, train tensors, query batches); use
+    :func:`global_batch` when each process loads only its own rows.
+    """
+    sharding = NamedSharding(mesh, spec)
+
+    def put(x):
+        x = np.asarray(x)
+        if not spans_processes(mesh):
+            return jax.device_put(x, sharding)
+        return jax.make_array_from_callback(x.shape, sharding, lambda idx: x[idx])
+
+    return jax.tree_util.tree_map(put, tree)

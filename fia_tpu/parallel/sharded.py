@@ -33,8 +33,12 @@ def shard_model_params(mesh: Mesh, params, model, axis: str = "model"):
     """Row-shard the embedding tables over ``axis``; replicate the rest.
 
     Row counts not divisible by the axis size are handled by XLA's
-    implicit padding of sharded dimensions.
+    implicit padding of sharded dimensions. Multi-process meshes are
+    supported via ``distributed.put_global`` (each process serves the
+    shards its devices own).
     """
+    from fia_tpu.parallel.distributed import put_global
+
     names = TABLE_PARAMS.get(type(model).__name__, ())
     out = {}
     for k, v in params.items():
@@ -42,7 +46,7 @@ def shard_model_params(mesh: Mesh, params, model, axis: str = "model"):
             spec = P(axis, *([None] * (v.ndim - 1)))
         else:
             spec = P()
-        out[k] = jax.device_put(v, NamedSharding(mesh, spec))
+        out[k] = put_global(mesh, v, spec)
     return out
 
 

@@ -29,8 +29,6 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import numpy as np
-
 # The axon (tunneled-TPU) image re-selects its platform via jax.config at
 # interpreter start, overriding JAX_PLATFORMS; honor an explicit CPU ask.
 if os.environ.get("JAX_PLATFORMS", "") == "cpu":
@@ -54,15 +52,26 @@ def main() -> None:
                          "'model' mesh axis (needs that many devices)")
     ap.add_argument("--batch_size", type=int, default=8192)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--coordinator", type=str, default=None,
+                    help="coordinator address for multi-host runs "
+                         "(host:port); joins the distributed runtime "
+                         "before any device use")
+    ap.add_argument("--num_processes", type=int, default=None)
+    ap.add_argument("--process_id", type=int, default=None)
     args = ap.parse_args()
+
+    from fia_tpu.parallel import distributed as dist
+
+    dist.initialize(coordinator_address=args.coordinator,
+                    num_processes=args.num_processes,
+                    process_id=args.process_id)
 
     import jax
 
-    from fia_tpu.data.synthetic import synthesize_ratings
+    from fia_tpu.data.synthetic import sample_heldout_pairs, synthesize_ratings
     from fia_tpu.eval.rq2 import time_influence_queries
     from fia_tpu.influence.engine import InfluenceEngine
     from fia_tpu.models import MF
-    from fia_tpu.parallel.sharded import make_2d_mesh
     from fia_tpu.train.trainer import Trainer, TrainConfig
 
     if args.smoke:
@@ -90,18 +99,29 @@ def main() -> None:
     mesh = None
     shard_tables = False
     if args.model_parallel > 1:
-        if jax.device_count() % args.model_parallel:
-            raise SystemExit(
-                f"--model_parallel {args.model_parallel} does not divide "
-                f"device count {jax.device_count()}"
-            )
-        mesh = make_2d_mesh(model_parallel=args.model_parallel)
+        # DCN-aware on multi-host runs ('model' stays on ICI within a
+        # host/slice); identical to make_2d_mesh single-host. Raises if
+        # model_parallel does not divide the per-granule device count.
+        try:
+            mesh = dist.make_hybrid_mesh(model_parallel=args.model_parallel)
+        except ValueError as e:
+            raise SystemExit(f"--model_parallel {args.model_parallel}: {e}")
         shard_tables = True
+
+    # Multi-host: train tensors become global (replicated) arrays so the
+    # jitted epoch scan runs SPMD across hosts; every process synthesized
+    # the same split above (same seed).
+    train_x, train_y = train.x, train.y
+    if mesh is not None and dist.spans_processes(mesh):
+        from jax.sharding import PartitionSpec as P
+
+        train_x = dist.put_global(mesh, train_x, P())
+        train_y = dist.put_global(mesh, train_y, P())
 
     tr = Trainer(model, TrainConfig(batch_size=batch, num_steps=steps,
                                     learning_rate=1e-2))
     t0 = time.perf_counter()
-    state = tr.fit(tr.init_state(params), train.x, train.y)
+    state = tr.fit(tr.init_state(params), train_x, train_y)
     train_s = time.perf_counter() - t0
     step_ms = 1e3 * train_s / max(steps, 1)
     print(f"stress: {steps} train steps in {train_s:.1f}s "
@@ -112,21 +132,7 @@ def main() -> None:
         pad_bucket=512, mesh=mesh, shard_tables=shard_tables,
     )
 
-    # Held-out query points, same protocol as bench.py: a pair present in
-    # train couples its p_u/q_i blocks and can make the related-set block
-    # Hessian indefinite — a regime the reference never queries. Membership
-    # is checked against ALL rows via packed (u * items + i) codes (a
-    # tuple set over 20M rows would cost GBs).
-    rng = np.random.default_rng(17)
-    codes = np.sort(train.x[:, 0].astype(np.int64) * items + train.x[:, 1])
-    pts = []
-    while len(pts) < n_q:
-        u, i = int(rng.integers(0, users)), int(rng.integers(0, items))
-        c = u * items + i
-        j = np.searchsorted(codes, c)
-        if j == len(codes) or codes[j] != c:
-            pts.append((u, i))
-    points = np.asarray(pts, dtype=np.int32)
+    points = sample_heldout_pairs(train.x, users, items, n_q, seed=17)
 
     timing = time_influence_queries(engine, points, repeats=3)
     out = {

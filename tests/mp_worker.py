@@ -1,0 +1,87 @@
+"""Worker for the 2-process multi-host integration test.
+
+Launched (twice) by ``tests/test_distributed.py::TestTwoProcess`` with a
+shared coordinator port. Each process sees 4 virtual CPU devices; after
+``distributed.initialize`` the global runtime has 2 processes x 4
+devices, granule detection groups by ``process_index``, and the hybrid
+('data', 'model') mesh spans both processes. Process 0 writes the
+influence scores to ``--out`` for the parent to compare against a
+single-process reference run. (Not a pytest module: the name does not
+match ``test_*.py``, so it is never collected.)
+"""
+
+import argparse
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--process_id", type=int, required=True)
+    ap.add_argument("--coordinator", type=str, required=True)
+    ap.add_argument("--pad_to", type=int, required=True)
+    ap.add_argument("--out", type=str, required=True)
+    args = ap.parse_args()
+
+    from fia_tpu.parallel import distributed as D
+
+    D.initialize(
+        coordinator_address=args.coordinator,
+        num_processes=2,
+        process_id=args.process_id,
+    )
+    info = D.runtime_info()
+    assert info.process_count == 2, info
+    assert info.global_device_count == 8, info
+
+    granules = D._granules(jax.devices())
+    assert len(granules) == 2 and all(len(g) == 4 for g in granules)
+
+    mesh = D.make_hybrid_mesh(model_parallel=2)
+    assert dict(mesh.shape) == {"data": 4, "model": 2}
+    # 'model' rows must not cross processes (ICI-only axis)
+    for row in mesh.devices:
+        assert len({d.process_index for d in row}) == 1
+
+    # Same deterministic workload as the parent's reference run.
+    from fia_tpu.data.dataset import RatingDataset
+    from fia_tpu.influence.engine import InfluenceEngine
+    from fia_tpu.models import MF
+
+    rng = np.random.default_rng(0)
+    n, users, items, k = 400, 20, 16, 4
+    x = np.stack([rng.integers(0, users, n), rng.integers(0, items, n)],
+                 axis=1).astype(np.int32)
+    y = rng.integers(1, 6, n).astype(np.float32)
+    train = RatingDataset(x, y)
+    model = MF(users, items, k, 1e-3)
+    params = model.init_params(jax.random.PRNGKey(0))
+
+    # global_batch path: each process feeds only its local rows
+    gx = D.global_batch(mesh, x[D.process_local_rows(n)], global_rows=n)
+    got = np.asarray(jax.jit(lambda a: a.sum())(gx.astype(np.int64)))
+    assert got == x.astype(np.int64).sum()
+
+    engine = InfluenceEngine(model, params, train, damping=1e-3,
+                             mesh=mesh, shard_tables=True)
+    pts = np.array([[3, 5], [0, 1], [7, 2], [11, 9]], np.int32)
+    res = engine.query_batch(pts, pad_to=args.pad_to)
+
+    if args.process_id == 0:
+        np.savez(args.out, scores=res.scores, counts=res.counts)
+    print(f"worker {args.process_id}: ok", flush=True)
+
+
+if __name__ == "__main__":
+    main()
