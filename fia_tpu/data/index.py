@@ -26,6 +26,26 @@ def _csr_from_ids(ids: np.ndarray, num_groups: int):
     return native.build_csr(ids, num_groups)
 
 
+def bucketed_pad(max_count: int, bucket: int, pad_to: int | None = None) -> int:
+    """Pad length for ragged related sets, or ``pad_to`` verbatim after
+    validating it fits.
+
+    Rounds ``max_count`` up to a multiple of ``bucket``; past 16×bucket
+    the granule grows geometrically (m/8, i.e. ~12.5% steps), so the
+    number of distinct pad lengths — and hence jit recompilations across
+    batches with different max related counts — is logarithmic, at
+    ≤12.5% padding waste."""
+    if pad_to is not None:
+        if max_count > pad_to:
+            raise ValueError(
+                f"pad_to={pad_to} smaller than max related count {max_count}"
+            )
+        return int(pad_to)
+    m = max(int(max_count), 1)
+    granule = max(bucket, 1 << max(0, m.bit_length() - 4))
+    return max(bucket, -(-m // granule) * granule)
+
+
 class InteractionIndex:
     def __init__(self, x: np.ndarray, num_users: int | None = None,
                  num_items: int | None = None):
@@ -56,6 +76,37 @@ class InteractionIndex:
             + self._i_indptr[i + 1] - self._i_indptr[i]
         )
 
+    def max_related_count(self) -> int:
+        """Upper bound on any query's related-set size: the heaviest user
+        degree plus the heaviest item degree. Padding to this ceiling
+        makes every batch share one compiled program."""
+        return int(
+            np.diff(self._u_indptr).max(initial=0)
+            + np.diff(self._i_indptr).max(initial=0)
+        )
+
+    def counts_batch(self, test_points: np.ndarray) -> np.ndarray:
+        """Related-set sizes for a (T, 2) batch — O(T) indptr diffs, no
+        gather."""
+        test_points = np.asarray(test_points)
+        u = test_points[:, 0]
+        i = test_points[:, 1]
+        return (
+            self._u_indptr[u + 1] - self._u_indptr[u]
+            + self._i_indptr[i + 1] - self._i_indptr[i]
+        ).astype(np.int32)
+
+    def postings(self):
+        """The raw CSR arrays (u_indptr, u_rows, i_indptr, i_rows).
+
+        Transferred to device once, these let the influence engine gather
+        related sets *inside* the jitted query — the only per-batch
+        host→device traffic is then the (T, 2) test points themselves
+        (the padded (T, P) index/mask transfer dominated end-to-end query
+        latency on interconnect-attached TPU hosts).
+        """
+        return self._u_indptr, self._u_rows, self._i_indptr, self._i_rows
+
     def related_padded(self, test_points: np.ndarray, pad_to: int | None = None,
                        bucket: int = 128):
         """Batched related sets as rectangular arrays.
@@ -73,13 +124,7 @@ class InteractionIndex:
         test_points = np.asarray(test_points)
         lists = [self.related(int(u), int(i)) for u, i in test_points]
         counts = np.array([len(l) for l in lists], dtype=np.int32)
-        if pad_to is None:
-            m = int(counts.max()) if len(lists) else 1
-            pad_to = max(bucket, ((m + bucket - 1) // bucket) * bucket)
-        elif counts.size and int(counts.max()) > pad_to:
-            raise ValueError(
-                f"pad_to={pad_to} smaller than max related count {counts.max()}"
-            )
+        pad_to = bucketed_pad(counts.max() if counts.size else 1, bucket, pad_to)
         idx = np.zeros((len(lists), pad_to), dtype=np.int32)
         mask = np.zeros((len(lists), pad_to), dtype=bool)
         for t, l in enumerate(lists):

@@ -57,16 +57,10 @@ def time_influence_queries(
     time is the best of ``repeats`` fenced runs, matching standard JAX
     benchmarking practice.
     """
+    # pad_to=None lets the engine pick per its own pad_policy — its choice
+    # is deterministic across repeats, so timing measures the same
+    # compiled program production queries would use.
     test_points = np.asarray(test_points)
-    if pad_to is None:
-        _, _, counts = engine.index.related_padded(
-            test_points, bucket=engine.pad_bucket
-        )
-        m = int(counts.max())
-        pad_to = max(
-            engine.pad_bucket,
-            -(-m // engine.pad_bucket) * engine.pad_bucket,
-        )
 
     t0 = time.perf_counter()
     res = engine.query_batch(test_points, pad_to=pad_to)

@@ -218,10 +218,14 @@ def put_global(mesh: Mesh, tree, spec: P):
     """
     sharding = NamedSharding(mesh, spec)
 
+    local = not spans_processes(mesh)
+
     def put(x):
-        x = np.asarray(x)
-        if not spans_processes(mesh):
+        if local:
+            # device_put reshards on-device; forcing np.asarray here would
+            # round-trip already-device-resident params through the host.
             return jax.device_put(x, sharding)
+        x = np.asarray(x)
         return jax.make_array_from_callback(x.shape, sharding, lambda idx: x[idx])
 
     return jax.tree_util.tree_map(put, tree)

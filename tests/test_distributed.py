@@ -164,7 +164,14 @@ class TestTwoProcess:
             )
             for p in (0, 1)
         ]
-        logs = [p.communicate(timeout=300)[0].decode() for p in procs]
+        try:
+            logs = [p.communicate(timeout=300)[0].decode() for p in procs]
+        finally:
+            # a crashed worker leaves its peer blocked in the coordinator
+            # handshake — don't leak it past the test
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
         for p, log in zip(procs, logs):
             assert p.returncode == 0, f"worker failed:\n{log}"
         got = np.load(out)

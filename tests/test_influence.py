@@ -261,6 +261,22 @@ class TestEngine:
                 batched.scores_of(t), single.scores_of(0), rtol=1e-4, atol=1e-6
             )
 
+    def test_dataset_pad_policy(self, model_cls):
+        """pad_policy='dataset' pads to the index-wide ceiling — one
+        compiled program for any batch — with identical scores."""
+        model, params, train = _setup(model_cls)
+        eng = InfluenceEngine(model, params, train, damping=DAMP, pad_bucket=8)
+        eng_d = InfluenceEngine(model, params, train, damping=DAMP,
+                                pad_bucket=8, pad_policy="dataset")
+        a = eng.query_batch(np.array([[3, 5], [7, 2]]))
+        b = eng_d.query_batch(np.array([[3, 5], [7, 2]]))
+        c = eng_d.query_batch(np.array([[1, 1]]))
+        assert b.scores.shape[1] >= eng_d.index.max_related_count()
+        for t in range(2):
+            np.testing.assert_allclose(a.scores_of(t), b.scores_of(t),
+                                       rtol=1e-4, atol=1e-6)
+        assert c.scores.shape[1] == b.scores.shape[1]
+
     def test_grouped_equals_ungrouped(self, model_cls):
         """group_queries=True splits the batch by pad bucket; scores,
         counts, and per-query ihvp must match the single-pad path."""
