@@ -53,6 +53,10 @@ def base_parser(desc: str) -> argparse.ArgumentParser:
                    help="force a JAX platform (default: auto)")
     p.add_argument("--solver", type=str, default="direct",
                    choices=["direct", "cg", "lissa"])
+    p.add_argument("--pad_policy", type=str, default="batch",
+                   choices=["batch", "dataset"],
+                   help="pad queries to the batch max (least compute) or "
+                        "the dataset ceiling (one compile for any batch)")
     p.add_argument("--data_dir", type=str, default="data")
     p.add_argument("--train_dir", type=str, default="output")
     p.add_argument("--batch_size", type=int, default=0,

@@ -37,7 +37,7 @@ def main(argv=None):
 
     engine = InfluenceEngine(
         model, state.params, train,
-        damping=args.damping, solver=args.solver, cg_tol=args.avextol * 1e-6,
+        damping=args.damping, solver=args.solver, pad_policy=args.pad_policy, cg_tol=args.avextol * 1e-6,
         cache_dir=args.train_dir, model_name=common.model_name_for(args),
     )
     test_indices = common.pick_test_points(args, splits, engine.index)

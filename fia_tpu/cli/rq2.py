@@ -32,7 +32,7 @@ def main(argv=None):
 
     engine = InfluenceEngine(
         model, state.params, train,
-        damping=args.damping, solver=args.solver,
+        damping=args.damping, solver=args.solver, pad_policy=args.pad_policy,
         cache_dir=args.train_dir, model_name=common.model_name_for(args),
     )
 

@@ -119,6 +119,46 @@ class TestInteractionIndex:
         rel = idx.related(1, 1)
         assert (rel == 0).sum() == 2
 
+    def test_counts_batch_and_ceiling(self):
+        ds = _ds(n=300, users=12, items=9, seed=2)
+        idx = InteractionIndex(ds.x)
+        pts = np.array([[0, 0], [3, 5], [11, 8]])
+        got = idx.counts_batch(pts)
+        want = [idx.related_count(u, i) for u, i in pts]
+        assert np.array_equal(got, want)
+        ceiling = idx.max_related_count()
+        all_pts = np.array([[u, i] for u in range(12) for i in range(9)])
+        assert ceiling >= idx.counts_batch(all_pts).max()
+
+    def test_postings_roundtrip(self):
+        ds = _ds(n=300, users=12, items=9, seed=2)
+        idx = InteractionIndex(ds.x)
+        uoff, urows, ioff, irows = idx.postings()
+        # the device gather layout (user rows then item rows) must
+        # reproduce related() exactly for every pair
+        for u, i in [(0, 0), (3, 5), (11, 8)]:
+            rebuilt = np.concatenate(
+                [urows[uoff[u]:uoff[u + 1]], irows[ioff[i]:ioff[i + 1]]]
+            )
+            assert np.array_equal(rebuilt, idx.related(u, i))
+
+    def test_bucketed_pad(self):
+        from fia_tpu.data.index import bucketed_pad
+
+        # explicit pad_to: validated passthrough
+        assert bucketed_pad(10, 16, pad_to=64) == 64
+        with pytest.raises(ValueError):
+            bucketed_pad(100, 16, pad_to=64)
+        for bucket in (16, 128, 512):
+            pads = {bucketed_pad(m, bucket) for m in range(1, 100_000)}
+            for m in range(1, 100_000, 977):
+                p = bucketed_pad(m, bucket)
+                assert p >= m and p % bucket == 0
+                assert p <= max(bucket, int(m * 1.125) + bucket)
+            # geometric granule keeps the number of distinct pads (jit
+            # cache entries) logarithmic in the count range
+            assert len(pads) < 120
+
     def test_related_padded(self):
         ds = _ds(n=300, users=12, items=9, seed=2)
         idx = InteractionIndex(ds.x)
