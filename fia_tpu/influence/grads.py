@@ -61,6 +61,26 @@ def per_example_block_loss_grads(model, params, u, i, x, y):
     return jax.vmap(one)(x, y)
 
 
+def per_example_block_prediction_grads(model, params, u, i, x):
+    """(B, d) matrix of g_j = ∇_block r̂(z_j), one row per example.
+
+    The Jacobian of the prediction w.r.t. the block — the J in
+    Gauss-Newton block-Hessian forms (H = (2/n) Jᵀ W J + corrections),
+    exact for models whose prediction is piecewise-linear in the block.
+    """
+    block0 = model.extract_block(params, u, i)
+    bvec0 = model.flatten_block(block0)
+
+    def one(xj):
+        def pred(bvec):
+            block = model.unflatten_block(bvec, block0)
+            return model.block_predict(params, block, u, i, xj[None, :])[0]
+
+        return jax.grad(pred)(bvec0)
+
+    return jax.vmap(one)(x)
+
+
 def per_example_full_loss_grads(model, params, x, y):
     """(B,) pytree-of-stacked per-example full-parameter loss gradients."""
 

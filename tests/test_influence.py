@@ -67,11 +67,10 @@ class TestBlockHVP:
             np.testing.assert_allclose(hvp(v), want, rtol=1e-2, atol=5e-5)
 
     def test_analytic_block_hessian_matches_autodiff(self, model_cls):
-        """MF's closed-form block Hessian == the autodiff-materialised
-        one, on a related set that includes the query pair itself (the
-        e_j cross-term case) and padding rows masked out."""
-        if model_cls is not MF:
-            pytest.skip("closed form implemented for MF only")
+        """The closed-form block Hessian (MF: masked matmuls; NCF:
+        Gauss-Newton + GMF bilinear correction) == the autodiff-
+        materialised one, on a related set that includes the query pair
+        itself (the e_j cross-term case) and padding rows masked out."""
         model, params, train = _setup(model_cls)
         u, i = 3, 5
         # ensure a (u, i) row exists so the residual cross term is live
