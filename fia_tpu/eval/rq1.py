@@ -47,6 +47,7 @@ def test_retraining(
     remove_type: str = "maxinf",
     random_seed: int = 17,
     clamp: float = 1.0,
+    lane_chunk: int = 32,
 ) -> RetrainResult:
     """Run the RQ1 experiment for one test point.
 
@@ -86,13 +87,30 @@ def test_retraining(
         random_seed + np.arange(retrain_times), len(lanes)
     ).astype(np.uint32)
 
-    params_stack = loo_retrain_many(
-        model, params0, train.x, train.y, all_removed,
-        num_steps=num_steps, batch_size=batch_size,
-        learning_rate=learning_rate, seeds=all_seeds,
+    # Lanes run in fixed-size chunks: one bounded device program per
+    # chunk (equal shapes reuse the compile), keeping peak memory and
+    # single-dispatch runtime independent of num_to_remove x
+    # retrain_times — a 100-lane x thousands-of-steps megaprogram can
+    # exceed worker/interconnect dispatch budgets at ML-1M scale.
+    lane_chunk = max(int(lane_chunk), 1)
+    pred_fn = jax.jit(jax.vmap(lambda p: model.predict(p, tx)[0]))
+    pad_lanes = (-len(all_removed)) % lane_chunk
+    padded_removed = np.concatenate(
+        [all_removed, np.full(pad_lanes, -1, all_removed.dtype)]
     )
-    preds = jax.jit(jax.vmap(lambda p: model.predict(p, tx)[0]))(params_stack)
-    preds = np.asarray(preds).reshape(len(lanes), retrain_times)
+    padded_seeds = np.concatenate(
+        [all_seeds, np.full(pad_lanes, random_seed, all_seeds.dtype)]
+    )
+    chunks = []
+    for c in range(0, len(padded_removed), lane_chunk):
+        params_stack = loo_retrain_many(
+            model, params0, train.x, train.y, padded_removed[c : c + lane_chunk],
+            num_steps=num_steps, batch_size=batch_size,
+            learning_rate=learning_rate, seeds=padded_seeds[c : c + lane_chunk],
+        )
+        chunks.append(np.asarray(pred_fn(params_stack)))
+    preds = np.concatenate(chunks)[: len(all_removed)]
+    preds = preds.reshape(len(lanes), retrain_times)
 
     # NaN-robust means (reference drops NaN retrain outcomes,
     # experiments.py:136-137).
