@@ -53,6 +53,26 @@ class TestEndToEnd:
         # bar is a strong positive correlation.
         assert r > 0.7, (r, rho, res.actual_y_diffs, res.predicted_y_diffs)
 
+    def test_lane_chunking_matches_single_dispatch(self, tiny_splits, trained):
+        """Chunked LOO-retrain lanes (lane_chunk smaller than the lane
+        count, with padding in the last chunk) must reproduce the
+        one-dispatch result exactly — same seeds, same schedule."""
+        model, state, _ = trained
+        train = tiny_splits["train"]
+        test = tiny_splits["test"]
+        engine = InfluenceEngine(model, state.params, train, damping=1e-4)
+        kw = dict(num_to_remove=5, num_steps=200, batch_size=200,
+                  learning_rate=1e-2, retrain_times=2)
+        one = run_retraining(engine, train, test, test_idx=1,
+                             lane_chunk=64, **kw)
+        chunked = run_retraining(engine, train, test, test_idx=1,
+                                 lane_chunk=3, **kw)
+        np.testing.assert_allclose(
+            chunked.actual_y_diffs, one.actual_y_diffs, rtol=1e-5, atol=1e-7
+        )
+        assert chunked.bias_retrain == pytest.approx(one.bias_retrain,
+                                                     abs=1e-7)
+
     def test_timing_harness(self, tiny_splits, trained):
         model, state, _ = trained
         engine = InfluenceEngine(model, state.params, tiny_splits["train"],
