@@ -382,37 +382,43 @@ class InfluenceEngine:
             # packed transfer (still ~5× below the unpacked (T, P) copy).
             total = int(counts.sum())
             s = 1 << max(10, (max(total, 2) - 1).bit_length())
-            packed, ihvp, v = self._batched_packed(pad, s)(
+            out = self._batched_packed(pad, s)(
                 self.params, self.train_x, self.train_y, self._postings,
                 u, i, tx,
             )
             rel_idx, rel_mask, _ = self.index.related_padded(
                 test_points, pad_to=pad
             )
+            # One device_get for all three outputs: separate np.asarray
+            # fetches serialise into per-array host round trips, which
+            # doubled steady-state batch latency on tunnel-attached chips.
+            packed, ihvp, v = jax.device_get(out)
             scores_np = np.zeros((T, pad), np.float32)
             # rel_mask rows are contiguous prefixes, so row-major boolean
             # assignment consumes the packed array in device order.
-            scores_np[rel_mask] = np.asarray(packed)[:total]
+            scores_np[rel_mask] = packed[:total]
             return InfluenceResult(
                 scores=scores_np,
                 related_idx=rel_idx,
                 related_mask=rel_mask,
                 counts=counts,
-                ihvp=np.asarray(ihvp),
-                test_grad=np.asarray(v),
+                ihvp=ihvp,
+                test_grad=v,
             )
 
-        scores, ihvp, v = self._batched(pad)(
+        out = self._batched(pad)(
             self.params, self.train_x, self.train_y, self._postings, u, i, tx
         )
         if self._multihost:
             # Data-sharded outputs span non-addressable devices; gather
-            # every process a full host copy before np.asarray below.
+            # every process a full host copy before the host fetch below.
             from jax.experimental import multihost_utils
 
             scores, ihvp, v = multihost_utils.process_allgather(
-                (scores, ihvp, v), tiled=True
+                out, tiled=True
             )
+        else:
+            scores, ihvp, v = jax.device_get(out)
         # Result row ids/mask come from the host CSR (same ordering as the
         # device gather: user postings then item postings) — cheap, and it
         # avoids shipping (T, P) int/bool arrays back over the interconnect.
