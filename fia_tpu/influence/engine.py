@@ -89,6 +89,7 @@ class InfluenceEngine:
         hessian_mode: str = "auto",
         group_queries: bool = False,
         pad_policy: str = "batch",
+        impl: str = "auto",
     ):
         if solver not in ("direct", "cg", "lissa"):
             raise ValueError(f"unknown solver {solver!r}")
@@ -152,6 +153,7 @@ class InfluenceEngine:
         # many-small-reduction closed form — so 'auto' picks by backend.
         if hessian_mode not in ("auto", "analytic", "autodiff"):
             raise ValueError(f"unknown hessian_mode {hessian_mode!r}")
+        self.hessian_mode = hessian_mode
         if hessian_mode == "analytic" and model.block_hessian is None:
             raise ValueError(
                 f"{type(model).__name__} defines no closed-form block_hessian"
@@ -175,6 +177,13 @@ class InfluenceEngine:
         if pad_policy not in ("batch", "dataset"):
             raise ValueError(f"unknown pad_policy {pad_policy!r}")
         self.pad_policy = pad_policy
+        # 'flat' = segment-sum path (device work ∝ actual related rows,
+        # not padded rows — see _flat_fn); 'padded' = per-query vmap at a
+        # common pad. 'auto' picks flat whenever eligible (single device,
+        # direct solver, model defines the Gauss-Newton hooks).
+        if impl not in ("auto", "flat", "padded"):
+            raise ValueError(f"unknown impl {impl!r}")
+        self.impl = impl
         self._jitted = {}  # pad length -> compiled batched query
 
     # -- the pure per-test-point query ------------------------------------
@@ -266,6 +275,171 @@ class InfluenceEngine:
             self._jitted[pad] = jax.jit(fn)
         return self._jitted[pad]
 
+    # -- flat segment-sum query path --------------------------------------
+    def _flat_fn(self, s_pad: int):
+        """All queries' related rows concatenated into one flat (S,)
+        axis; per-query Hessians accumulated by segment scatter-add.
+
+        The padded per-query layout wastes compute proportionally to
+        max/mean related-set skew (~10× on ML-1M: pad 3584 vs mean 356);
+        here device work scales with the ACTUAL total row count. Requires
+        the model's Gauss-Newton hooks (``block_cross_const`` /
+        ``block_reg_diag``, see models/base.py) and the direct solver.
+        Outputs are identical in layout to ``_batched_packed``: flat
+        scores in query order (user postings then item postings), plus
+        (T, d) ihvp and test vectors.
+        """
+        key = ("flat", s_pad)
+        if key in self._jitted:
+            return self._jitted[key]
+        model = self.model
+        d = model.block_size
+        chunk = 2048  # bounds the (chunk, d, d) outer-product buffer
+
+        def fn(params, train_x, train_y, postings, tx):
+            T = tx.shape[0]
+            u, i = tx[:, 0], tx[:, 1]
+            uoff, urows, ioff, irows = postings
+            nu = uoff[u + 1] - uoff[u]
+            ni = ioff[i + 1] - ioff[i]
+            counts = nu + ni
+            off = jnp.concatenate(
+                [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts, dtype=jnp.int32)]
+            )
+            total = off[-1]
+
+            s = jnp.arange(s_pad, dtype=jnp.int32)
+            t = jnp.clip(jnp.searchsorted(off, s, side="right") - 1, 0, T - 1)
+            pos = s - off[t]
+            valid = s < total
+            ut, it = u[t], i[t]
+            row = jnp.where(
+                pos < nu[t],
+                urows[jnp.clip(uoff[ut] + pos, 0, urows.shape[0] - 1)],
+                irows[jnp.clip(ioff[it] + pos - nu[t], 0, irows.shape[0] - 1)],
+            )
+            rel_x = train_x[row]
+            rel_y = train_y[row]
+            wv = valid.astype(jnp.float32)
+
+            # per-flat-row prediction gradients w.r.t. the owning query's
+            # block (the J of the Gauss-Newton form)
+            def one_g(xj, uu, ii):
+                block0 = model.extract_block(params, uu, ii)
+
+                def pred(bvec):
+                    block = model.unflatten_block(bvec, block0)
+                    return model.block_predict(
+                        params, block, uu, ii, xj[None, :]
+                    )[0]
+
+                return jax.grad(pred)(model.flatten_block(block0))
+
+            g = jax.vmap(one_g)(rel_x, ut, it)  # (S, d)
+            e = model.predict(params, rel_x) - rel_y
+
+            # H_t = (2/n_t) Σ_{s∈t} w (g gᵀ + a b e C) + diag(reg) + λI,
+            # accumulated in chunks so the outer-product buffer stays small
+            nc = s_pad // chunk
+            g_r = g.reshape(nc, chunk, d)
+            t_r = t.reshape(nc, chunk)
+            w_r = wv.reshape(nc, chunk)
+
+            def body(acc, args):
+                gc, tc, wc = args
+                outer = (gc * wc[:, None])[:, :, None] * gc[:, None, :]
+                return acc.at[tc].add(outer), None
+
+            HH = jax.lax.scan(
+                body, jnp.zeros((T, d, d), jnp.float32), (g_r, t_r, w_r)
+            )[0]
+            ab = wv * (rel_x[:, 0] == ut) * (rel_x[:, 1] == it)
+            sum_abe = jnp.zeros((T,), jnp.float32).at[t].add(ab * e)
+            n_t = jnp.maximum(counts.astype(jnp.float32), 1.0)
+            C = model.block_cross_const(params)
+            rdiag = model.block_reg_diag(params)
+            H = (2.0 / n_t)[:, None, None] * (
+                HH + sum_abe[:, None, None] * C[None]
+            ) + jnp.diag(rdiag + self.damping)[None]
+
+            v = jax.vmap(
+                lambda uu, ii, xj: G.block_prediction_grad(
+                    model, params, uu, ii, xj[None, :]
+                )
+            )(u, i, tx)
+            ihvp = jax.vmap(solvers.solve_direct)(H, v)
+
+            # score_s = ∇_block L(z_s) · ihvp_t / n_t, with the per-example
+            # loss gradient 2 e g + wd·θ̃ (θ̃ = decayed block dims)
+            theta = jax.vmap(
+                lambda uu, ii: model.flatten_block(
+                    model.extract_block(params, uu, ii)
+                )
+            )(u, i)
+            reg_dot = jnp.sum(theta * rdiag[None] * ihvp, axis=1)  # (T,)
+            scores = wv * (
+                2.0 * e * jnp.einsum("sd,sd->s", g, ihvp[t]) + reg_dot[t]
+            ) / n_t[t]
+            return scores, ihvp, v
+
+        self._jitted[key] = jax.jit(fn)
+        return self._jitted[key]
+
+    def _flat_eligible(self) -> bool:
+        return (
+            self.mesh is None
+            and self.solver == "direct"
+            and not self.use_pallas
+            and not self.group_queries
+            # the flat path always builds the Hessian from the analytic
+            # GN hooks — an explicit 'autodiff' request must be honored
+            and self.hessian_mode != "autodiff"
+            # 'dataset' promises one compiled program and a uniform
+            # output pad across batches — a padded-path contract
+            and self.pad_policy == "batch"
+            and self.model.block_cross_const is not None
+            and self.model.block_reg_diag is not None
+        )
+
+    def _query_flat(
+        self, test_points: np.ndarray, pad_to: int | None = None
+    ) -> InfluenceResult:
+        counts = self.index.counts_batch(test_points)
+        total = int(counts.sum())
+        # chunk-divisible power-of-two S (same bucketing as the packed path)
+        s_pad = 1 << max(11, (max(total, 2) - 1).bit_length())
+        tx = jnp.asarray(test_points, jnp.int32)
+        out = self._flat_fn(s_pad)(
+            self.params, self.train_x, self.train_y, self._postings, tx
+        )
+        pad = bucketed_pad(
+            counts.max() if counts.size else 1, self.pad_bucket, pad_to
+        )
+        return self._assemble_packed(test_points, counts, out, pad)
+
+    def _assemble_packed(self, test_points, counts, out, pad: int) -> InfluenceResult:
+        """Re-expand flat device outputs into the padded result layout.
+
+        One device_get for all outputs (separate per-array fetches
+        serialise into host round trips); row ids/mask from the host CSR,
+        whose contiguous-prefix mask rows consume the packed scores in
+        device order (user postings then item postings).
+        """
+        packed, ihvp, v = jax.device_get(out)
+        T = test_points.shape[0]
+        total = int(counts.sum())
+        rel_idx, rel_mask, _ = self.index.related_padded(test_points, pad_to=pad)
+        scores_np = np.zeros((T, pad), np.float32)
+        scores_np[rel_mask] = packed[:total]
+        return InfluenceResult(
+            scores=scores_np,
+            related_idx=rel_idx,
+            related_mask=rel_mask,
+            counts=counts,
+            ihvp=ihvp,
+            test_grad=v,
+        )
+
     def _batched_packed(self, pad: int, s: int):
         """Single-device fast path: compact the (T, P) padded scores into
         a flat (S,) valid-only array *on device* before they cross the
@@ -314,6 +488,14 @@ class InfluenceEngine:
         if test_points.ndim == 1:
             test_points = test_points[None, :]
         T = test_points.shape[0]
+
+        if self.impl in ("auto", "flat") and self._flat_eligible():
+            return self._query_flat(test_points, pad_to)
+        if self.impl == "flat":
+            raise ValueError(
+                "impl='flat' requires a single-device engine with the "
+                "direct solver and a model defining the Gauss-Newton hooks"
+            )
 
         if self.group_queries and pad_to is None and T > 1:
             counts = self.index.counts_batch(test_points).astype(np.int64)
@@ -386,25 +568,7 @@ class InfluenceEngine:
                 self.params, self.train_x, self.train_y, self._postings,
                 u, i, tx,
             )
-            rel_idx, rel_mask, _ = self.index.related_padded(
-                test_points, pad_to=pad
-            )
-            # One device_get for all three outputs: separate np.asarray
-            # fetches serialise into per-array host round trips, which
-            # doubled steady-state batch latency on tunnel-attached chips.
-            packed, ihvp, v = jax.device_get(out)
-            scores_np = np.zeros((T, pad), np.float32)
-            # rel_mask rows are contiguous prefixes, so row-major boolean
-            # assignment consumes the packed array in device order.
-            scores_np[rel_mask] = packed[:total]
-            return InfluenceResult(
-                scores=scores_np,
-                related_idx=rel_idx,
-                related_mask=rel_mask,
-                counts=counts,
-                ihvp=ihvp,
-                test_grad=v,
-            )
+            return self._assemble_packed(test_points, counts, out, pad)
 
         out = self._batched(pad)(
             self.params, self.train_x, self.train_y, self._postings, u, i, tx

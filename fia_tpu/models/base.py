@@ -162,6 +162,20 @@ class LatentFactorModel:
     #: the Hessian through ``block_size`` autodiff HVPs.
     block_hessian = None
 
+    #: optional Gauss-Newton decomposition hooks, enabling the engine's
+    #: flat segment-sum query path. They assert that the exact block
+    #: Hessian of ``block_loss`` over rows (x, y, w) decomposes as
+    #:   H = (2/n) Σ_j w_j (g_j g_jᵀ + a_j b_j e_j · C) + diag(r)
+    #: with g_j = ∇_block r̂(z_j), e_j the residual, a_j/b_j the
+    #: user/item match indicators, C = ``block_cross_const(params)``
+    #: (∇²r̂ on rows equal to the query pair — constant in (u, i) for
+    #: MF/NCF), and r = ``block_reg_diag(params)`` the L2 diagonal.
+    #: Holds exactly when r̂ is piecewise-linear in the block except for
+    #: bilinear terms joining the user and item rows (MF dot product,
+    #: NCF GMF branch).
+    block_cross_const = None
+    block_reg_diag = None
+
     def block_loss(self, params: Params, block: Block, u, i, x, y, w=None):
         err = self.indiv_loss_from_pred(
             self.block_predict(params, block, u, i, x), y

@@ -152,6 +152,25 @@ class MF(LatentFactorModel):
             axis=0,
         )
 
+    def block_cross_const(self, params):
+        """∇²r̂ on rows equal to the query pair: ∇²(pu·qi) = [[0 I];[I 0]]
+        in the (pu, qi) blocks (see block_hessian's cross term)."""
+        k = self.embedding_size
+        d = self.block_size
+        r = jnp.arange(k)
+        C = jnp.zeros((d, d), jnp.float32)
+        C = C.at[r, k + r].set(1.0)
+        return C.at[k + r, r].set(1.0)
+
+    def block_reg_diag(self, params):
+        """L2 diagonal: wd on the embedding dims, none on the biases
+        (only P/Q are decayed, reference matrix_factorization.py:92-97)."""
+        k = self.embedding_size
+        return jnp.concatenate(
+            [jnp.full((2 * k,), self.weight_decay, jnp.float32),
+             jnp.zeros((2,), jnp.float32)]
+        )
+
     @property
     def block_size(self) -> int:
         return 2 * self.embedding_size + 2

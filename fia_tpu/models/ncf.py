@@ -116,25 +116,34 @@ class NCF(LatentFactorModel):
         """
         from fia_tpu.influence.grads import per_example_block_prediction_grads
 
-        k = self.embedding_size
-        d = self.block_size
         xu, xi = x[:, 0], x[:, 1]
         wf = w.astype(jnp.float32)
-        n = jnp.maximum(jnp.sum(wf), 1.0)
-        c = 2.0 / n
+        c = 2.0 / jnp.maximum(jnp.sum(wf), 1.0)
 
         block = self.extract_block(params, u, i)
         g = per_example_block_prediction_grads(self, params, u, i, x)
         e = self.block_predict(params, block, u, i, x) - y
-
-        H = c * (g.T * wf) @ g + self.weight_decay * jnp.eye(d, dtype=jnp.float32)
         ab = wf * (xu == u).astype(jnp.float32) * (xi == i).astype(jnp.float32)
-        # W3 rows [k//2:] fuse the GMF branch (block layout: pu_mlp,
-        # qi_mlp, pu_gmf, qi_gmf -> gmf cross block at [2k:3k] x [3k:4k])
-        cross = c * jnp.sum(ab * e) * jnp.diag(params["W3"][k // 2 :, 0])
-        H = H.at[2 * k : 3 * k, 3 * k : 4 * k].add(cross)
-        H = H.at[3 * k : 4 * k, 2 * k : 3 * k].add(cross.T)
-        return H
+        return (
+            c * (g.T * wf) @ g
+            + c * jnp.sum(ab * e) * self.block_cross_const(params)
+            + jnp.diag(self.block_reg_diag(params))
+        )
+
+    def block_cross_const(self, params):
+        """∇²r̂ on rows equal to the query pair: the GMF bilinear cross
+        block diag(W3's gmf rows) (see block_hessian's derivation)."""
+        k = self.embedding_size
+        d = self.block_size
+        r = jnp.arange(k)
+        w3g = params["W3"][k // 2 :, 0]
+        C = jnp.zeros((d, d), jnp.float32)
+        C = C.at[2 * k + r, 3 * k + r].set(w3g)
+        return C.at[3 * k + r, 2 * k + r].set(w3g)
+
+    def block_reg_diag(self, params):
+        """All four embedding rows are decayed (reference NCF.py:29-41)."""
+        return jnp.full((self.block_size,), self.weight_decay, jnp.float32)
 
     @property
     def block_size(self) -> int:

@@ -260,13 +260,38 @@ class TestEngine:
                 batched.scores_of(t), single.scores_of(0), rtol=1e-4, atol=1e-6
             )
 
+    def test_flat_equals_padded(self, model_cls):
+        """The flat segment-sum path (impl='flat', the single-device
+        default) must reproduce the padded per-query path — scores,
+        ihvp, and test vectors — including a query whose (u, i) pair is
+        present in the training set (the bilinear cross-term case)."""
+        model, params, train = _setup(model_cls)
+        # a training pair queried directly exercises sum_abe * C
+        pair = tuple(train.x[0])
+        pts = np.array([[3, 5], pair, [0, 1]], np.int32)
+        flat = InfluenceEngine(model, params, train, damping=DAMP,
+                               impl="flat").query_batch(pts)
+        padded = InfluenceEngine(model, params, train, damping=DAMP,
+                                 impl="padded").query_batch(pts)
+        assert np.array_equal(flat.counts, padded.counts)
+        np.testing.assert_allclose(flat.ihvp, padded.ihvp, rtol=1e-3,
+                                   atol=1e-5)
+        np.testing.assert_allclose(flat.test_grad, padded.test_grad,
+                                   rtol=1e-4, atol=1e-6)
+        for t in range(len(pts)):
+            np.testing.assert_allclose(
+                flat.scores_of(t), padded.scores_of(t), rtol=1e-3, atol=1e-5
+            )
+
     def test_dataset_pad_policy(self, model_cls):
         """pad_policy='dataset' pads to the index-wide ceiling — one
         compiled program for any batch — with identical scores."""
         model, params, train = _setup(model_cls)
-        eng = InfluenceEngine(model, params, train, damping=DAMP, pad_bucket=8)
+        eng = InfluenceEngine(model, params, train, damping=DAMP, pad_bucket=8,
+                              impl="padded")
         eng_d = InfluenceEngine(model, params, train, damping=DAMP,
-                                pad_bucket=8, pad_policy="dataset")
+                                pad_bucket=8, pad_policy="dataset",
+                                impl="padded")
         a = eng.query_batch(np.array([[3, 5], [7, 2]]))
         b = eng_d.query_batch(np.array([[3, 5], [7, 2]]))
         c = eng_d.query_batch(np.array([[1, 1]]))
