@@ -283,6 +283,27 @@ class TestEngine:
                 flat.scores_of(t), padded.scores_of(t), rtol=1e-3, atol=1e-5
             )
 
+    def test_zero_related_query(self, model_cls):
+        """A query whose user and item never appear in training has an
+        empty related set: no scores, finite ihvp (pure reg+damping
+        system), on both impls."""
+        rng = np.random.default_rng(3)
+        # id space one larger than the data actually uses: the last
+        # user/item never appear in training
+        x = np.stack([rng.integers(0, U - 1, 200),
+                      rng.integers(0, I - 1, 200)], 1).astype(np.int32)
+        y = rng.integers(1, 6, 200).astype(np.float32)
+        train = RatingDataset(x, y)
+        model = model_cls(U, I, K, WD)
+        params = model.init_params(jax.random.PRNGKey(0))
+        unseen = np.array([[U - 1, I - 1]])
+        for impl in ("flat", "padded"):
+            res = InfluenceEngine(model, params, train, damping=DAMP,
+                                  impl=impl).query_batch(unseen)
+            assert res.counts[0] == 0
+            assert res.scores_of(0).size == 0
+            assert np.isfinite(res.ihvp).all()
+
     def test_dataset_pad_policy(self, model_cls):
         """pad_policy='dataset' pads to the index-wide ceiling — one
         compiled program for any batch — with identical scores."""
