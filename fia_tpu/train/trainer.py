@@ -195,6 +195,7 @@ def loo_retrain_many(
     batch_size: int,
     learning_rate: float = 1e-3,
     seeds=None,
+    steps_per_dispatch: int = 2000,
 ):
     """Leave-one-out retraining, vmapped over removed points.
 
@@ -219,11 +220,13 @@ def loo_retrain_many(
     else:
         seeds = jnp.asarray(seeds, jnp.uint32)
 
-    def retrain_one(ridx, seed):
+    def advance(params, opt_state, t, ridx, keys_seg):
+        """One lane, one dispatch segment: scan over keys_seg epochs.
+        Steps past num_steps are masked no-ops, so padded epochs in the
+        final segment leave params untouched."""
         w = jnp.ones((n,), jnp.float32).at[
             jnp.clip(ridx, 0, n - 1)
         ].set(jnp.where(ridx >= 0, 0.0, 1.0))
-        opt_state = opt.init(params0)
 
         def epoch(carry, ekey):
             params, opt_state, t = carry
@@ -249,11 +252,42 @@ def loo_retrain_many(
             (params, opt_state, t), _ = jax.lax.scan(step, (params, opt_state, t), sched)
             return (params, opt_state, t), None
 
-        n_epochs = -(-num_steps // nb)
-        keys = jax.random.split(jax.random.PRNGKey(seed), n_epochs)
-        (params, _, _), _ = jax.lax.scan(
-            epoch, (params0, opt_state, jnp.int32(0)), keys
+        (params, opt_state, t), _ = jax.lax.scan(
+            epoch, (params, opt_state, t), keys_seg
         )
-        return params
+        return params, opt_state, t
 
-    return jax.jit(jax.vmap(retrain_one))(removed, seeds)
+    n_epochs = -(-num_steps // nb)
+    # Long vmapped training programs must be split across dispatches:
+    # a single many-minute device program can exceed worker/interconnect
+    # execution budgets (observed: 32-lane x 6000-step NCF retrains kill
+    # the tunneled TPU worker; ~2000-step dispatches are safe).
+    seg_epochs = max(1, min(n_epochs, steps_per_dispatch // nb or 1))
+    # Exactly n_epochs keys per lane, independent of the dispatch split
+    # (jax.random.split(key, num)[i] depends on num, so splitting into a
+    # padded count would make results vary with the tuning knob — and
+    # diverge from the pre-split single-program behavior).
+    keys = jax.vmap(
+        lambda s: jax.random.split(jax.random.PRNGKey(s), n_epochs)
+    )(seeds)  # (R, n_epochs, 2)
+
+    # donate the lane stacks: each segment's params/opt buffers alias the
+    # previous one's instead of doubling peak HBM at every boundary
+    adv = jax.jit(
+        jax.vmap(advance, in_axes=(0, 0, 0, 0, 0)), donate_argnums=(0, 1, 2)
+    )
+    R = removed.shape[0]
+    params = jax.tree_util.tree_map(
+        lambda l: jnp.broadcast_to(l, (R, *l.shape)), params0
+    )
+    opt_state = jax.tree_util.tree_map(
+        lambda l: jnp.broadcast_to(l, (R, *jnp.shape(l))), opt.init(params0)
+    )
+    t = jnp.zeros((R,), jnp.int32)
+    # the ragged tail scans only the remaining epochs (one extra compile)
+    # rather than a padded segment of masked no-op steps
+    for start in range(0, n_epochs, seg_epochs):
+        seg = keys[:, start : start + seg_epochs]
+        params, opt_state, t = adv(params, opt_state, t, removed, seg)
+        jax.block_until_ready(t)
+    return params
