@@ -45,6 +45,10 @@ def base_parser(desc: str) -> argparse.ArgumentParser:
     p.add_argument("--reset_adam", type=int, default=0)
     p.add_argument("--load_checkpoint", type=int, default=1)
     p.add_argument("--retrain_times", type=int, default=4)
+    p.add_argument("--num_to_remove", type=int, default=50,
+                   help="training rows removed per test point for RQ1 "
+                        "ground truth (experiments.py:18 default; the "
+                        "reference RQ1 driver passes 1)")
     p.add_argument("--sort_test_case", type=int, default=0,
                    help="1: pick the least-supported test points")
     # framework knobs

@@ -44,11 +44,10 @@ def main(argv=None):
     print(f"test indices: {list(map(int, test_indices))}")
 
     actuals, predictions = [], []
-    num_to_remove = min(50, args.num_test and 50)
     for t in test_indices:
         res = test_retraining(
             engine, train, test, int(t),
-            num_to_remove=num_to_remove,
+            num_to_remove=args.num_to_remove,
             num_steps=args.num_steps_retrain,
             batch_size=batch,
             learning_rate=args.lr,

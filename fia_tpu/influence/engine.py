@@ -8,9 +8,17 @@ influence on the test *prediction*.
 
 Where the reference mutates its TF graph per test point and loops
 ``sess.run`` per training row, this engine compiles ONE pure function of
-(u*, i*, padded related rows) and ``vmap``s it over a whole batch of test
-queries; with a device mesh the query batch is sharded data-parallel
-(params replicated, queries split across devices over ICI).
+the test batch. Two implementations:
+
+- flat (single-device default): every query's related rows on one flat
+  axis, Gauss-Newton block Hessians accumulated by segment — device
+  work scales with rows actually scored (``_flat_fn``);
+- padded: per-query ``vmap`` at a common pad — required for meshes
+  (query batch sharded data-parallel over ICI, params replicated),
+  CG/LiSSA solvers, and models without the Gauss-Newton hooks.
+
+Both gather related sets on device from resident CSR postings and ship
+compact outputs in a single host round trip (see docs/design.md §2).
 """
 
 from __future__ import annotations
