@@ -75,10 +75,16 @@ def base_parser(desc: str) -> argparse.ArgumentParser:
 
 
 def apply_backend(args) -> None:
-    if args.backend == "cpu":
-        os.environ.setdefault("JAX_PLATFORMS", "cpu")
-    elif args.backend == "tpu":
-        os.environ.setdefault("JAX_PLATFORMS", "tpu")
+    if args.backend not in ("cpu", "tpu"):
+        return
+    # jax is already imported by this module's own imports, so the env var
+    # alone is too late (it is read once at jax import); jax.config still
+    # takes effect as long as no backend has been initialised yet. The env
+    # var is set too so spawned subprocesses inherit the choice.
+    os.environ["JAX_PLATFORMS"] = args.backend
+    import jax
+
+    jax.config.update("jax_platforms", args.backend)
 
 
 def load_splits(args):

@@ -43,7 +43,7 @@ def main(argv=None):
     test_indices = common.pick_test_points(args, splits, engine.index)
     print(f"test indices: {list(map(int, test_indices))}")
 
-    actuals, predictions = [], []
+    actuals, predictions, removed = [], [], []
     for t in test_indices:
         res = test_retraining(
             engine, train, test, int(t),
@@ -59,13 +59,21 @@ def main(argv=None):
               f"(bias_retrain {res.bias_retrain:+.5f})")
         actuals.append(res.actual_y_diffs)
         predictions.append(res.predicted_y_diffs)
+        removed.append(res.indices_to_remove)
 
+        # per-test-point rows can be ragged (a test point's related set
+        # may hold fewer than num_to_remove rows), so stack as flat
+        # arrays plus per-row test-point ids rather than a (T, R) matrix
         os.makedirs(args.train_dir, exist_ok=True)
         np.savez(
             os.path.join(args.train_dir, f"RQ1-{args.model}-{args.dataset}.npz"),
-            actual_loss_diffs=np.array(actuals),
-            predicted_loss_diffs=np.array(predictions),
-            indices_to_remove=res.indices_to_remove,
+            actual_loss_diffs=np.concatenate(actuals),
+            predicted_loss_diffs=np.concatenate(predictions),
+            indices_to_remove=np.concatenate(removed),
+            test_index_of_row=np.repeat(
+                [int(i) for i in test_indices[: len(actuals)]],
+                [len(a) for a in actuals],
+            ),
         )
 
     a = np.concatenate(actuals)

@@ -1,45 +1,35 @@
 """Fidelity metrics: Pearson (reference RQ1.py:165) and Spearman (the
-BASELINE.json north-star: rank correlation >= 0.99 vs the reference)."""
+BASELINE.json north-star: rank correlation >= 0.99 vs the reference).
+
+Thin finite-masking wrappers over scipy.stats — the reference itself
+scores RQ1 with ``scipy.stats.pearsonr`` (RQ1.py:165), so delegating
+keeps the metric definitions identical by construction.
+"""
 
 from __future__ import annotations
 
 import numpy as np
+from scipy import stats
+
+
+def _masked(a, b):
+    a = np.asarray(a, np.float64)
+    b = np.asarray(b, np.float64)
+    mask = np.isfinite(a) & np.isfinite(b)
+    return a[mask], b[mask]
 
 
 def pearson(a, b) -> float:
-    a = np.asarray(a, np.float64)
-    b = np.asarray(b, np.float64)
-    mask = np.isfinite(a) & np.isfinite(b)
-    a, b = a[mask], b[mask]
-    if len(a) < 2:
+    a, b = _masked(a, b)
+    if len(a) < 2 or np.ptp(a) == 0 or np.ptp(b) == 0:
         return float("nan")
-    a = a - a.mean()
-    b = b - b.mean()
-    denom = np.sqrt((a * a).sum() * (b * b).sum())
-    return float((a * b).sum() / denom) if denom else float("nan")
-
-
-def _ranks(v: np.ndarray) -> np.ndarray:
-    order = np.argsort(v, kind="stable")
-    ranks = np.empty(len(v), np.float64)
-    ranks[order] = np.arange(len(v))
-    # average ties
-    sv = v[order]
-    i = 0
-    while i < len(sv):
-        j = i
-        while j + 1 < len(sv) and sv[j + 1] == sv[i]:
-            j += 1
-        if j > i:
-            ranks[order[i : j + 1]] = (i + j) / 2.0
-        i = j + 1
-    return ranks
+    r, _ = stats.pearsonr(a, b)  # tuple unpack works on all scipy versions
+    return float(r)
 
 
 def spearman(a, b) -> float:
-    a = np.asarray(a, np.float64)
-    b = np.asarray(b, np.float64)
-    mask = np.isfinite(a) & np.isfinite(b)
-    if mask.sum() < 2:
+    a, b = _masked(a, b)
+    if len(a) < 2 or np.ptp(a) == 0 or np.ptp(b) == 0:
         return float("nan")
-    return pearson(_ranks(a[mask]), _ranks(b[mask]))
+    rho, _ = stats.spearmanr(a, b)
+    return float(rho)

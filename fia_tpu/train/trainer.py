@@ -48,7 +48,7 @@ class Trainer:
         self.optimizer = optax.adam(config.learning_rate)
         self.sgd = optax.sgd(config.learning_rate * 10.0)
         self.event_log = event_log  # utils.logging.EventLog or None
-        self._epoch_fn = None
+        self._epoch_fns = {}  # (n_rows, n_batches) -> compiled epoch
         self._full_fns = {}
 
     # -- state -------------------------------------------------------------
@@ -137,15 +137,24 @@ class Trainer:
         y = jnp.asarray(y)
         w = jnp.ones((n,), jnp.float32) if weights is None else jnp.asarray(weights)
 
-        switch_b = cfg.iter_to_switch_to_batch or num_steps
-        switch_s = cfg.iter_to_switch_to_sgd or num_steps
+        switch_b = cfg.iter_to_switch_to_batch
+        switch_b = num_steps if switch_b is None else switch_b
+        switch_s = cfg.iter_to_switch_to_sgd
+        switch_s = num_steps if switch_s is None else switch_s
         mini_steps = min(num_steps, switch_b)
-        batch_steps = min(num_steps, switch_s) - mini_steps
+        # switch_s <= switch_b matches the reference's phase test order
+        # (genericNeuralNet.py:388-398): minibatch wins until switch_b,
+        # then SGD immediately — the full-batch Adam phase is empty.
+        batch_steps = max(0, min(num_steps, switch_s) - mini_steps)
         sgd_steps = num_steps - mini_steps - batch_steps
 
         params, opt_state = state.params, state.opt_state
-        if self._epoch_fn is None:
-            self._epoch_fn = self._make_epoch_fn(n, nb, batch)
+        # keyed per dataset shape: retraining on a leave-one-out subset or
+        # a swapped train set must not reuse a closure compiled with the
+        # old row count (stale permutation range + batch schedule)
+        epoch_fn = self._epoch_fns.get((n, nb))
+        if epoch_fn is None:
+            epoch_fn = self._epoch_fns[(n, nb)] = self._make_epoch_fn(n, nb, batch)
 
         done = 0
         key = jax.random.PRNGKey(cfg.seed)
@@ -153,7 +162,7 @@ class Trainer:
         while done < mini_steps:
             todo = min(nb, mini_steps - done)
             ekey = jax.random.fold_in(key, epoch_i)
-            params, opt_state, losses = self._epoch_fn(
+            params, opt_state, losses = epoch_fn(
                 params, opt_state, x, y, w, ekey, jnp.int32(todo)
             )
             done += todo

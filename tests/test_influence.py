@@ -350,3 +350,22 @@ class TestEngine:
         cached = list(tmp_path.glob("*.npz"))
         assert len(cached) == 1
         assert "inverse_hvp" in np.load(cached[0])
+
+        # cache hit: force_refresh=False must serve the stored result
+        # without recomputing (reference genericNeuralNet.py:724-735)
+        eng.query_batch = None  # any recompute would now raise
+        hit = eng.get_influence_on_test_loss([0], test_ds, force_refresh=False)
+        np.testing.assert_allclose(hit, scores)
+
+        # a different trained checkpoint must NOT be served the old
+        # scores (filename key doesn't identify params — fingerprint does)
+        params2 = jax.tree_util.tree_map(lambda a: a * 1.01, eng.params)
+        eng2 = InfluenceEngine(model, params2, train, damping=DAMP,
+                               cache_dir=str(tmp_path), model_name="m")
+        fresh = eng2.get_influence_on_test_loss([0], test_ds, force_refresh=False)
+        assert not np.allclose(fresh, scores)
+
+        # corrupt cache files self-heal instead of crashing the query
+        cached[0].write_bytes(b"not a zip")
+        healed = eng2.get_influence_on_test_loss([0], test_ds, force_refresh=False)
+        np.testing.assert_allclose(healed, fresh)

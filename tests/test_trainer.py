@@ -58,6 +58,68 @@ class TestTrainer:
                         jax.tree_util.tree_leaves(s_masked2.params)):
             np.testing.assert_allclose(a, b, atol=1e-7)
 
+    def test_refit_on_resized_dataset(self, tiny_splits):
+        """A second fit() on a different-sized dataset must not reuse the
+        epoch closure compiled for the first (stale permutation range +
+        batch schedule) — e.g. the reference LOO retrain-on-subset flow."""
+        model, params, train = _model_and_data(tiny_splits)
+        cfg = TrainConfig(batch_size=100, num_steps=30, learning_rate=1e-2)
+        tr = Trainer(model, cfg)
+        tr.fit(tr.init_state(params), train.x, train.y)  # caches full-size fn
+
+        sub_x, sub_y = train.x[:150], train.y[:150]
+        got = tr.fit(tr.init_state(params), sub_x, sub_y)
+        fresh = Trainer(model, cfg).fit(
+            Trainer(model, cfg).init_state(params), sub_x, sub_y
+        )
+        for a, b in zip(jax.tree_util.tree_leaves(got.params),
+                        jax.tree_util.tree_leaves(fresh.params)):
+            np.testing.assert_allclose(a, b, atol=1e-7)
+
+    def test_full_batch_from_step_zero(self, tiny_splits):
+        """iter_to_switch_to_batch=0 means full-batch Adam for ALL steps
+        (0 must not be coerced to 'unset')."""
+        model, params, train = _model_and_data(tiny_splits)
+        n = 100
+        x, y = train.x[:n], train.y[:n]
+        cfg = TrainConfig(batch_size=10, num_steps=5, learning_rate=1e-2,
+                          iter_to_switch_to_batch=0)
+        s1 = Trainer(model, cfg).fit(
+            Trainer(model, cfg).init_state(params), x, y
+        )
+        # reference full-batch == minibatch with batch_size = n
+        cfg2 = TrainConfig(batch_size=n, num_steps=5, learning_rate=1e-2)
+        s2 = Trainer(model, cfg2).fit(
+            Trainer(model, cfg2).init_state(params), x, y
+        )
+        for a, b in zip(jax.tree_util.tree_leaves(s1.params),
+                        jax.tree_util.tree_leaves(s2.params)):
+            np.testing.assert_allclose(a, b, atol=1e-6)
+
+    def test_sgd_before_batch_switch_order(self, tiny_splits):
+        """switch_sgd < switch_batch: minibatch runs to switch_batch, the
+        (empty) full-batch-Adam phase is skipped, SGD covers the rest —
+        never more than num_steps total optimizer updates."""
+        model, params, train = _model_and_data(tiny_splits)
+        cfg = TrainConfig(batch_size=100, num_steps=8, learning_rate=1e-3,
+                          iter_to_switch_to_batch=6, iter_to_switch_to_sgd=2)
+        s1 = Trainer(model, cfg).fit(
+            Trainer(model, cfg).init_state(params), train.x, train.y
+        )
+        # equivalent explicit phases: 6 minibatch steps + 2 SGD steps
+        cfg_a = TrainConfig(batch_size=100, num_steps=6, learning_rate=1e-3)
+        mid = Trainer(model, cfg_a).fit(
+            Trainer(model, cfg_a).init_state(params), train.x, train.y
+        )
+        cfg_b = TrainConfig(batch_size=100, num_steps=2, learning_rate=1e-3,
+                            iter_to_switch_to_batch=0, iter_to_switch_to_sgd=0)
+        s2 = Trainer(model, cfg_b).fit(
+            Trainer(model, cfg_b).init_state(mid.params), train.x, train.y
+        )
+        for a, b in zip(jax.tree_util.tree_leaves(s1.params),
+                        jax.tree_util.tree_leaves(s2.params)):
+            np.testing.assert_allclose(a, b, atol=1e-6)
+
     def test_phase_switches_run(self, tiny_splits):
         model, params, train = _model_and_data(tiny_splits)
         cfg = TrainConfig(batch_size=200, num_steps=30, learning_rate=1e-3,
