@@ -56,7 +56,7 @@ def base_parser(desc: str) -> argparse.ArgumentParser:
                    choices=[None, "tpu", "cpu"],
                    help="force a JAX platform (default: auto)")
     p.add_argument("--solver", type=str, default="direct",
-                   choices=["direct", "cg", "lissa"])
+                   choices=["direct", "cg", "lissa", "schulz"])
     p.add_argument("--pad_policy", type=str, default="batch",
                    choices=["batch", "dataset"],
                    help="pad queries to the batch max (least compute) or "

@@ -16,6 +16,7 @@ import os
 import numpy as np
 
 from fia_tpu.cli import common
+from fia_tpu.utils.io import save_npz_atomic
 
 
 def main(argv=None):
@@ -64,8 +65,7 @@ def main(argv=None):
         # per-test-point rows can be ragged (a test point's related set
         # may hold fewer than num_to_remove rows), so stack as flat
         # arrays plus per-row test-point ids rather than a (T, R) matrix
-        os.makedirs(args.train_dir, exist_ok=True)
-        np.savez(
+        save_npz_atomic(
             os.path.join(args.train_dir, f"RQ1-{args.model}-{args.dataset}.npz"),
             actual_loss_diffs=np.concatenate(actuals),
             predicted_loss_diffs=np.concatenate(predictions),

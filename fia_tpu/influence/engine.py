@@ -66,9 +66,10 @@ class InfluenceEngine:
       params: trained parameter pytree.
       train: the training RatingDataset.
       damping: Hessian damping λ (reference default 1e-6, RQ1.py:20).
-      solver: 'direct' (materialise + Cholesky; exact, TPU-fast default),
-        'cg' (matrix-free, fmin_ncg-equivalent on this quadratic), or
-        'lissa'.
+      solver: 'direct' (materialise + LU solve; exact, TPU-fast default),
+        'cg' (matrix-free, fmin_ncg-equivalent on this quadratic),
+        'lissa', or 'schulz' (matmul-only Newton–Schulz inversion,
+        beyond-reference option).
       mesh: optional jax Mesh with a 'data' axis; query batches are then
         sharded across it. With a 2-D ('data', 'model') mesh, pass
         ``shard_tables=True`` to row-shard the embedding tables over the
@@ -99,7 +100,7 @@ class InfluenceEngine:
         pad_policy: str = "batch",
         impl: str = "auto",
     ):
-        if solver not in ("direct", "cg", "lissa"):
+        if solver not in ("direct", "cg", "lissa", "schulz"):
             raise ValueError(f"unknown solver {solver!r}")
         self.model = model
         self.params = jax.tree_util.tree_map(jnp.asarray, params)
@@ -219,14 +220,21 @@ class InfluenceEngine:
         v = G.block_prediction_grad(model, params, u, i, test_x[None, :])
 
         hvp = H.make_block_hvp(model, params, u, i, rel_x, rel_y, w, self.damping)
-        if self.solver == "direct":
+        if self.solver in ("direct", "schulz"):
             d = model.block_size
             if self._analytic_hessian:
                 Hmat = model.block_hessian(params, u, i, rel_x, rel_y, w)
                 Hmat = Hmat + self.damping * jnp.eye(d, dtype=jnp.float32)
             else:
                 Hmat = jax.vmap(hvp)(jnp.eye(d, dtype=jnp.float32))
-            ihvp = solvers.solve_direct(Hmat, v)
+            if self.solver == "schulz":
+                # same knobs as CG; an unreachably tight tol is safe (the
+                # solver's best-iterate/divergence guard caps iterations)
+                ihvp = solvers.solve_schulz(
+                    Hmat, v, maxiter=self.cg_maxiter, tol=self.cg_tol
+                )
+            else:
+                ihvp = solvers.solve_direct(Hmat, v)
         elif self.solver == "cg":
             ihvp = solvers.solve_cg(hvp, v, maxiter=self.cg_maxiter, tol=self.cg_tol)
         else:
@@ -652,28 +660,26 @@ class InfluenceEngine:
         if cache is not None and (
             force_refresh or stale or not os.path.exists(cache)
         ):
-            os.makedirs(self.cache_dir, exist_ok=True)
-            # private tmp published by atomic rename: no truncated cache
-            # on kill, no interleaving between concurrent writers
-            import tempfile
+            from fia_tpu.utils.io import save_npz_atomic
 
-            fd, tmp = tempfile.mkstemp(dir=self.cache_dir, suffix=".npz")
-            with os.fdopen(fd, "wb") as f:
-                np.savez(f, inverse_hvp=res.ihvp[0], scores=res.scores_of(0),
-                         params_fp=self._params_fingerprint())
-            os.replace(tmp, cache)
+            save_npz_atomic(cache, inverse_hvp=res.ihvp[0],
+                            scores=res.scores_of(0),
+                            params_fp=self._params_fingerprint())
         return res.scores_of(0)
 
     def _params_fingerprint(self) -> np.ndarray:
         """Cheap checkpoint identity for cache validation: per-leaf sum
         and L2 norm (order-stable via tree flatten). Params are fixed for
-        the engine's lifetime, so computed once."""
+        the engine's lifetime, so computed once — on device, so sharded
+        embedding tables aren't gathered to host just for two scalars."""
         if getattr(self, "_params_fp", None) is None:
-            stats = []
-            for leaf in jax.tree_util.tree_leaves(self.params):
-                a = np.asarray(leaf, np.float64)
-                stats.extend([a.sum(), np.sqrt((a * a).sum())])
-            self._params_fp = np.asarray(stats)
+            stats = [
+                s
+                for leaf in jax.tree_util.tree_leaves(self.params)
+                for s in (jnp.sum(leaf), jnp.linalg.norm(jnp.ravel(leaf)))
+            ]
+            self._params_fp = np.asarray(jax.device_get(jnp.stack(stats)),
+                                         np.float64)
         return self._params_fp
 
     def related_indices(self, test_point) -> np.ndarray:

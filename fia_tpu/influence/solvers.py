@@ -14,6 +14,9 @@ Gauss-Newton-ish block Hessian), so:
   - ``solve_lissa``: the stochastic Neumann-series recursion
     cur ← v + (1−λ)·cur − H(cur)/scale, result cur/scale, matching the
     reference's update (``genericNeuralNet.py:533``).
+  - ``solve_schulz``: matmul-only Newton–Schulz inversion of the
+    materialised block Hessian (beyond-reference option; HyperINF,
+    arXiv:2410.05090).
 
 All solvers are jit- and vmap-friendly.
 """
@@ -82,6 +85,66 @@ def solve_cg(
 
     x, *_ = lax.while_loop(cond, body, (x, r, p, rs, jnp.int32(0)))
     return x
+
+
+def solve_schulz(
+    H: jnp.ndarray, v: jnp.ndarray, maxiter: int = 128, tol: float = 1e-6
+) -> jnp.ndarray:
+    """Hyperpower (Newton–Schulz) solve: iterate X ← X(2I − HX), x = Xv.
+
+    Matmul-only inversion of the materialised block Hessian — maps
+    straight onto the MXU (no triangular solves, no host loops) and
+    converges quadratically from X₀ = Hᵀ/(‖H‖₁‖H‖∞), which satisfies
+    ‖I − HX₀‖ < 1 for any nonsingular H. The Schulz-iteration approach
+    to influence-function inverses follows HyperINF (arXiv:2410.05090);
+    here the FIA block system is small (d = 2k+2 / 4k), so the (d, d)
+    iterates are cheap and batch cleanly under vmap over query batches.
+
+    Iterations run until the RMS of the residual matrix I − HX drops
+    below ``tol`` (the solve error obeys ‖Hx − v‖ ≤ ‖I − HX‖·‖v‖), up
+    to ``maxiter``; convergence needs ≈ 2·log₂(κ(H)) + 6 iterations,
+    with a long flat plateau first when κ is large (slow modes shrink
+    below float32 resolution per step), so a plateau must NOT stop the
+    loop. Beyond κ ~ 1/eps(float32) no 32-bit solver can reach tol and
+    the quadratic iteration amplifies rounding instead — the loop
+    tracks the best iterate and exits on material divergence (residual
+    doubling, or NaN), returning that best (never NaN). Iterating past
+    convergence keeps the best iterate, so lanes of mixed conditioning
+    under a vmapped while_loop are safe.
+    """
+    d = H.shape[-1]
+    eye = jnp.eye(d, dtype=H.dtype)
+    norm1 = jnp.max(jnp.sum(jnp.abs(H), axis=-2))
+    norminf = jnp.max(jnp.sum(jnp.abs(H), axis=-1))
+    X0 = H.T / jnp.maximum(norm1 * norminf, 1e-30)
+
+    # full fp32 matmuls: the TPU MXU's default bf16 accumulation floors
+    # the residual around 1e-2 — the plateau phase then never ends and
+    # the divergence guard returns a barely-improved X0
+    mm = lambda a, b: jnp.matmul(a, b, precision=jax.lax.Precision.HIGHEST)
+
+    def resid(X):
+        R = eye - mm(H, X)
+        return jnp.sqrt(jnp.mean(jnp.square(R)))
+
+    r0 = resid(X0)
+
+    def cond(state):
+        _, _, r_best, r_cur, it = state
+        ok = jnp.isfinite(r_cur) & (r_cur < 2.0 * r_best)
+        return (r_best > tol) & ok & (it < maxiter)
+
+    def body(state):
+        X_cur, X_best, r_best, _, it = state
+        X_new = mm(X_cur, 2.0 * eye - mm(H, X_cur))
+        r_new = resid(X_new)
+        better = jnp.isfinite(r_new) & (r_new < r_best)
+        X_best = jnp.where(better, X_new, X_best)
+        r_best = jnp.where(better, r_new, r_best)
+        return X_new, X_best, r_best, r_new, it + 1
+
+    _, X, *_ = lax.while_loop(cond, body, (X0, X0, r0, r0, jnp.int32(0)))
+    return mm(X, v)
 
 
 def solve_lissa(

@@ -1,0 +1,29 @@
+"""Small IO helpers shared by the engine cache and experiment drivers."""
+
+from __future__ import annotations
+
+import os
+import tempfile
+
+import numpy as np
+
+
+def save_npz_atomic(path: str, **arrays) -> None:
+    """np.savez published by atomic rename.
+
+    A kill mid-write must never leave a truncated npz at ``path`` (the
+    engine's inverse-HVP cache is read back; RQ sweeps accumulate hours
+    of results in one file). A private mkstemp tmp also keeps concurrent
+    writers from interleaving into each other's files.
+    """
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".npz")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **arrays)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise

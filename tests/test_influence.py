@@ -199,6 +199,44 @@ class TestSolvers:
         for x, v in zip(xs, vs):
             np.testing.assert_allclose(H @ x, v, rtol=1e-3, atol=1e-3)
 
+    def test_schulz_matches_direct(self):
+        H, v = self._system()
+        x = solvers.solve_schulz(H, v)
+        np.testing.assert_allclose(x, solvers.solve_direct(H, v),
+                                   rtol=1e-3, atol=1e-4)
+
+    def test_schulz_ill_conditioned(self):
+        """Realistic FIA conditioning (near-singular Gauss-Newton block,
+        damping 1e-3; kappa ~ 5e4) must converge — not stop at a fixed
+        iteration budget."""
+        rng = np.random.default_rng(1)
+        d = 34
+        A = rng.normal(size=(d, 3))  # rank-3 => tiny tail eigenvalues
+        H = jnp.asarray(A @ A.T + 1e-3 * np.eye(d), jnp.float32)
+        v = jnp.asarray(rng.normal(size=d), jnp.float32)
+        x = solvers.solve_schulz(H, v)
+        res = float(jnp.linalg.norm(H @ x - v) / jnp.linalg.norm(v))
+        assert res < 1e-2, f"relative residual {res}"
+
+    def test_schulz_never_nan_beyond_float32(self):
+        """Past float32's conditioning limit (kappa ~ 5e7, where even LU
+        fails) the best-iterate guard must return finite values, not
+        diverge to NaN."""
+        rng = np.random.default_rng(1)
+        d = 34
+        A = rng.normal(size=(d, 3))
+        H = jnp.asarray(A @ A.T + 1e-6 * np.eye(d), jnp.float32)
+        v = jnp.asarray(rng.normal(size=d), jnp.float32)
+        x = solvers.solve_schulz(H, v)
+        assert np.isfinite(np.asarray(x)).all()
+
+    def test_schulz_under_vmap(self):
+        H, _ = self._system()
+        vs = jnp.stack([jnp.ones(10), jnp.arange(10.0)])
+        xs = jax.vmap(lambda v: solvers.solve_schulz(H, v))(vs)
+        for x, v in zip(xs, vs):
+            np.testing.assert_allclose(H @ x, v, rtol=1e-3, atol=1e-3)
+
     def test_lissa_converges(self):
         # LiSSA needs ||H/scale|| < 1
         d = 6
@@ -245,8 +283,12 @@ class TestEngine:
                                solver="direct").query_batch(pts)
         cg = InfluenceEngine(model, params, train, damping=pd_damp,
                              solver="cg", cg_tol=1e-12).query_batch(pts)
+        schulz = InfluenceEngine(model, params, train, damping=pd_damp,
+                                 solver="schulz").query_batch(pts)
         for t in range(2):
             np.testing.assert_allclose(base.scores_of(t), cg.scores_of(t),
+                                       rtol=1e-3, atol=1e-6)
+            np.testing.assert_allclose(base.scores_of(t), schulz.scores_of(t),
                                        rtol=1e-3, atol=1e-6)
 
     def test_batched_equals_single(self, model_cls):
