@@ -226,7 +226,9 @@ class InfluenceEngine:
                 Hmat = model.block_hessian(params, u, i, rel_x, rel_y, w)
                 Hmat = Hmat + self.damping * jnp.eye(d, dtype=jnp.float32)
             else:
-                Hmat = jax.vmap(hvp)(jnp.eye(d, dtype=jnp.float32))
+                Hmat = H.materialize_block_hessian(
+                    model, params, u, i, rel_x, rel_y, w, self.damping
+                )
             if self.solver == "schulz":
                 # same knobs as CG; an unreachably tight tol is safe (the
                 # solver's best-iterate/divergence guard caps iterations)
@@ -668,18 +670,24 @@ class InfluenceEngine:
         return res.scores_of(0)
 
     def _params_fingerprint(self) -> np.ndarray:
-        """Cheap checkpoint identity for cache validation: per-leaf sum
-        and L2 norm (order-stable via tree flatten). Params are fixed for
-        the engine's lifetime, so computed once — on device, so sharded
-        embedding tables aren't gathered to host just for two scalars."""
+        """Cache-validation identity: per-leaf sum and L2 norm of the
+        checkpoint (order-stable via tree flatten; computed on device so
+        sharded embedding tables aren't gathered to host just for two
+        scalars) plus the solve configuration — the cache filename keys
+        the solver name but not damping/tolerances, and stale scores
+        from a different solve setup must not be served."""
         if getattr(self, "_params_fp", None) is None:
             stats = [
                 s
                 for leaf in jax.tree_util.tree_leaves(self.params)
                 for s in (jnp.sum(leaf), jnp.linalg.norm(jnp.ravel(leaf)))
             ]
-            self._params_fp = np.asarray(jax.device_get(jnp.stack(stats)),
-                                         np.float64)
+            cfg = [self.damping, self.cg_tol, float(self.cg_maxiter),
+                   self.lissa_scale, float(self.lissa_depth)]
+            self._params_fp = np.concatenate([
+                np.asarray(jax.device_get(jnp.stack(stats)), np.float64),
+                np.asarray(cfg, np.float64),
+            ])
         return self._params_fp
 
     def related_indices(self, test_point) -> np.ndarray:

@@ -60,7 +60,9 @@ class FullInfluenceEngine:
         if mesh is not None:
             shard = NamedSharding(mesh, P("data"))
             n = train.num_examples
-            drop = n % mesh.devices.size
+            # divisibility is only needed along the sharded 'data' axis —
+            # n % devices.size would needlessly drop rows on 2-D meshes
+            drop = n % mesh.shape["data"]
             if drop:  # keep shards equal; influence over N-drop rows
                 self.train_x = self.train_x[: n - drop]
                 self.train_y = self.train_y[: n - drop]

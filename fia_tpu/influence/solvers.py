@@ -165,9 +165,17 @@ def solve_lissa(
     damping 0 (the Hessian damping lives inside ``hvp``).
     """
 
-    def one_sample(_, acc):
+    def one_sample(i, acc):
         def body(j, cur):
-            hv = sample_hvp(j, cur) if sample_hvp is not None else hvp(cur)
+            # offset by the sample index so repetitions draw distinct
+            # minibatch sequences (the reference re-fills per repetition,
+            # genericNeuralNet.py:516-533) — without it every "sample"
+            # would be bit-identical and the averaging a no-op
+            hv = (
+                sample_hvp(i * recursion_depth + j, cur)
+                if sample_hvp is not None
+                else hvp(cur)
+            )
             return v + (1.0 - damping) * cur - hv / scale
 
         cur = lax.fori_loop(0, recursion_depth, body, v)

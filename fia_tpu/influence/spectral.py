@@ -47,17 +47,21 @@ def extreme_eigvals(
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """(largest, smallest) eigenvalues of the symmetric operator.
 
-    Smallest via the spectral shift H' = H - λ_max I (reference's
+    Second extreme via the spectral shift H' = H − λ_d I (reference's
     intended approach, per the surviving scaffolding at
-    ``genericNeuralNet.py:786-806``).
+    ``genericNeuralNet.py:786-806``). Power iteration converges to the
+    dominant-*magnitude* eigenvalue, so λ_d may be the most-negative one
+    (indefinite Hessians away from an optimum); the two passes together
+    always yield both extremes — order them by value, not by pass.
     """
-    lam_max, _ = power_iteration(hvp, dim, num_iters, key)
+    lam_dom, _ = power_iteration(hvp, dim, num_iters, key)
 
     def shifted(v):
-        return hvp(v) - lam_max * v
+        return hvp(v) - lam_dom * v
 
     lam_shift, _ = power_iteration(shifted, dim, num_iters, key)
-    return lam_max, lam_shift + lam_max
+    other = lam_shift + lam_dom
+    return jnp.maximum(lam_dom, other), jnp.minimum(lam_dom, other)
 
 
 def block_hessian_eigvals(H: jnp.ndarray) -> jnp.ndarray:

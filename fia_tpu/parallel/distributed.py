@@ -156,13 +156,40 @@ def make_hybrid_mesh(
     return Mesh(dev_arr, axis_names)
 
 
-def process_local_rows(n_global: int) -> slice:
+def process_local_rows(
+    n_global: int, mesh: Mesh | None = None, axis: str = "data"
+) -> slice:
     """The contiguous row range of a global batch this process feeds.
 
-    Rows are split as evenly as possible over processes (first
-    ``n_global % process_count`` processes take one extra row), covering
-    ``[0, n_global)`` exactly across all processes.
+    ``NamedSharding`` supports only even partitions, so ``n_global``
+    must divide the sharded axis — :func:`global_batch` would raise the
+    same requirement from inside
+    :func:`jax.make_array_from_process_local_data` anyway; callers pad
+    batches to a mesh multiple first (the engine's query path does).
+    With ``mesh``, the range is read off the actual sharding's
+    device→index map (and the divisibility error surfaces here, early,
+    with this guidance); without one, rows are split evenly over
+    processes — equal to the sharding boundaries for every divisible
+    count.
     """
+    if mesh is not None:
+        axis_size = mesh.shape[axis]
+        if n_global % axis_size:
+            raise ValueError(
+                f"n_global={n_global} does not divide the '{axis}' axis "
+                f"(size {axis_size}); NamedSharding supports only even "
+                "partitions — pad the batch to a mesh multiple first"
+            )
+        sharding = NamedSharding(mesh, P(axis))
+        me = jax.process_index()
+        spans = [
+            idx[0]
+            for d, idx in sharding.devices_indices_map((n_global,)).items()
+            if d.process_index == me
+        ]
+        starts = [0 if s.start is None else s.start for s in spans]
+        stops = [n_global if s.stop is None else s.stop for s in spans]
+        return slice(min(starts), max(stops))
     p, np_ = jax.process_index(), jax.process_count()
     base, extra = divmod(n_global, np_)
     start = p * base + min(p, extra)

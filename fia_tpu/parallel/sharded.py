@@ -22,10 +22,19 @@ TABLE_PARAMS = {
 
 
 def make_2d_mesh(n_devices: int | None = None, model_parallel: int = 2) -> Mesh:
-    """('data', 'model') mesh; model_parallel divides the device count."""
+    """('data', 'model') mesh; ``model_parallel`` must divide the device
+    count — raises rather than silently unsharding the tables (a config
+    that asked for table sharding because they exceed one device's HBM
+    must not fall back to full replication; same contract as
+    ``distributed.make_hybrid_mesh``)."""
     devs = jax.devices() if n_devices is None else jax.devices()[:n_devices]
     n = len(devs)
-    mp = model_parallel if n % model_parallel == 0 else 1
+    if n % model_parallel:
+        raise ValueError(
+            f"model_parallel={model_parallel} does not divide the device "
+            f"count {n}"
+        )
+    mp = model_parallel
     return Mesh(np.asarray(devs).reshape(n // mp, mp), ("data", "model"))
 
 

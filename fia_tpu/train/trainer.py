@@ -222,6 +222,8 @@ def loo_retrain_many(
     y = jnp.asarray(y)
     n = x.shape[0]
     nb = n // batch_size
+    if nb == 0:
+        raise ValueError("batch_size larger than dataset")
     opt = optax.adam(learning_rate)
     removed = jnp.asarray(removed_indices, jnp.int32)
     if seeds is None:

@@ -69,7 +69,7 @@ def main() -> None:
     params = model.init_params(jax.random.PRNGKey(0))
 
     # global_batch path: each process feeds only its local rows
-    gx = D.global_batch(mesh, x[D.process_local_rows(n)], global_rows=n)
+    gx = D.global_batch(mesh, x[D.process_local_rows(n, mesh)], global_rows=n)
     got = np.asarray(jax.jit(lambda a: a.sum())(gx.astype(np.int64)))
     assert got == x.astype(np.int64).sum()
 

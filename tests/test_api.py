@@ -81,6 +81,17 @@ class TestSpectral:
         np.testing.assert_allclose(float(lam_max), w[-1], rtol=1e-3)
         np.testing.assert_allclose(float(lam_min), w[0], atol=1e-2 * w[-1])
 
+    def test_indefinite_negative_dominant(self):
+        """When the dominant-magnitude eigenvalue is negative (indefinite
+        Hessian away from an optimum), (largest, smallest) must still
+        come back in value order, not pass order."""
+        import jax.numpy as jnp
+
+        H = jnp.diag(jnp.array([-10.0, -2.0, 1.0, 3.0], jnp.float32))
+        lam_max, lam_min = extreme_eigvals(lambda v: H @ v, 4, num_iters=500)
+        np.testing.assert_allclose(float(lam_max), 3.0, rtol=1e-3)
+        np.testing.assert_allclose(float(lam_min), -10.0, rtol=1e-3)
+
     def test_block_eigvals(self):
         import jax.numpy as jnp
 

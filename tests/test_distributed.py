@@ -77,6 +77,35 @@ class TestGlobalBatch:
         sl = D.process_local_rows(13)
         assert sl == slice(0, 13)  # single process feeds everything
 
+    def test_local_rows_match_sharding_boundaries(self):
+        """The mesh-aware variant must reproduce NamedSharding's shard
+        map exactly, and a global_batch built from it must round-trip."""
+        mesh = D.make_hybrid_mesh()
+        n = 16
+        sl = D.process_local_rows(n, mesh)
+        sharding = jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec("data")
+        )
+        spans = sharding.devices_indices_map((n,)).values()
+        lo = min(0 if s[0].start is None else s[0].start for s in spans)
+        hi = max(n if s[0].stop is None else s[0].stop for s in spans)
+        assert (sl.start, sl.stop) == (lo, hi)
+        x = np.arange(n, dtype=np.float32)
+        got = D.global_batch(mesh, x[sl], global_rows=n)
+        np.testing.assert_array_equal(np.asarray(got), x)
+
+    def test_local_rows_ragged_raises_early(self):
+        """NamedSharding supports only even partitions; the ragged case
+        must fail here with guidance, not deep inside
+        make_array_from_process_local_data."""
+        mesh = D.make_hybrid_mesh()
+        try:
+            D.process_local_rows(10, mesh)  # 10 % 8 != 0
+        except ValueError as e:
+            assert "pad the batch" in str(e)
+        else:
+            raise AssertionError("expected ValueError")
+
     def test_global_batch_matches_device_put(self):
         mesh = D.make_hybrid_mesh()
         x = np.arange(32, dtype=np.float32).reshape(16, 2)
