@@ -111,11 +111,17 @@ class FIAModel:
               load_checkpoints: int | bool = False):
         if load_checkpoints:
             self.load_checkpoint(int(load_checkpoints), do_checks=False)
-            remaining = max(0, num_steps - int(load_checkpoints) - 1)
+            done = int(load_checkpoints) + 1
         else:
-            remaining = num_steps
-        self._trainer.config.iter_to_switch_to_batch = iter_to_switch_to_batch
-        self._trainer.config.iter_to_switch_to_sgd = iter_to_switch_to_sgd
+            done = 0
+        remaining = max(0, num_steps - done)
+        # the switch thresholds are ABSOLUTE step indices (reference
+        # semantics, genericNeuralNet.py:388-398) but the resumed fit()
+        # counts from 0 — shift them by the steps already trained so a
+        # resumed run reproduces a fresh run's phase schedule
+        rel = lambda v: None if v is None else max(0, v - done)
+        self._trainer.config.iter_to_switch_to_batch = rel(iter_to_switch_to_batch)
+        self._trainer.config.iter_to_switch_to_sgd = rel(iter_to_switch_to_sgd)
         if remaining:
             train = self.data_sets["train"]
             self.state = self._trainer.fit(self.state, train.x, train.y,
@@ -133,7 +139,9 @@ class FIAModel:
         """Reference MF.retrain (matrix_factorization.py:69-76): reset the
         optimizer, run minibatch steps on the given (possibly
         leave-one-out) dataset."""
-        train = train or self.data_sets["train"]
+        # `or` would misfire: RatingDataset defines __len__, so an empty
+        # leave-out dataset is falsy and must not fall back to full train
+        train = self.data_sets["train"] if train is None else train
         self.state = self._trainer.retrain(self.state, train.x, train.y,
                                            num_steps=num_steps,
                                            reset_adam=reset_adam)
@@ -183,13 +191,19 @@ class FIAModel:
         if loss_type != "normal_loss":
             raise ValueError("loss must be normal_loss")
         eng = self.engine()
-        if approx_type and approx_type != eng.solver:
-            solver = {"cg": "cg", "lissa": "lissa"}.get(approx_type, "direct")
+        if approx_type and approx_type not in ("direct", "cg", "lissa", "schulz"):
+            raise ValueError(
+                f"unknown approx_type {approx_type!r}; "
+                "use direct|cg|lissa|schulz"
+            )
+        if (approx_type and approx_type != eng.solver) or approx_params:
+            # approx_params keys are InfluenceEngine kwargs
+            # (cg_maxiter, cg_tol, lissa_scale, lissa_depth, ...)
             eng = InfluenceEngine(
                 self.model, self.state.params, self.data_sets["train"],
-                damping=self.damping, solver=solver,
+                damping=self.damping, solver=approx_type or eng.solver,
                 cache_dir=self.train_dir, model_name=self.model_name,
-                mesh=self.mesh,
+                mesh=self.mesh, **(approx_params or {}),
             )
         return eng.get_influence_on_test_loss(
             test_indices, self.data_sets["test"],

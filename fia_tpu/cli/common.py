@@ -74,6 +74,17 @@ def base_parser(desc: str) -> argparse.ArgumentParser:
     return p
 
 
+def cg_tol_for(args) -> float:
+    """Engine cg_tol from the reference's --avextol knob.
+
+    fmin_ncg's avextol bounds the change in the quadratic objective; the
+    CG loop stops on the squared-residual ratio, so the scale differs —
+    1e-6·avextol reproduces the reference's effective accuracy at its
+    default avextol=1e-3. One mapping shared by all drivers.
+    """
+    return args.avextol * 1e-6
+
+
 def apply_backend(args) -> None:
     if args.backend not in ("cpu", "tpu"):
         return

@@ -33,6 +33,7 @@ def main(argv=None):
     engine = InfluenceEngine(
         model, state.params, train,
         damping=args.damping, solver=args.solver, pad_policy=args.pad_policy,
+        cg_tol=common.cg_tol_for(args),
         cache_dir=args.train_dir, model_name=common.model_name_for(args),
     )
 

@@ -117,13 +117,41 @@ def synthetic_splits(
     seed: int = 0,
     **kw,
 ) -> dict[str, RatingDataset]:
-    """Train/validation/test splits from one planted model."""
-    full = synthesize_ratings(
-        num_users, num_items, num_train + 2 * num_test, seed=seed, **kw
+    """Train/validation/test splits from one planted model.
+
+    Valid/test pairs are kept DISJOINT from the training pairs (as the
+    reference's real splits are): an in-train query pair appears twice in
+    its own related set and couples the block through the shared residual
+    — the regime ``sample_heldout_pairs`` documents as one the reference
+    never queries, which the CLI drivers would otherwise hit at random.
+    """
+    margin = 4
+    while True:
+        full = synthesize_ratings(
+            num_users, num_items, num_train + margin * num_test, seed=seed, **kw
+        )
+        train_x, train_y = full.x[:num_train], full.y[:num_train]
+        codes = np.sort(
+            np.asarray(train_x[:, 0], np.int64) * num_items
+            + np.asarray(train_x[:, 1], np.int64)
+        )
+        rest_x, rest_y = full.x[num_train:], full.y[num_train:]
+        rc = np.asarray(rest_x[:, 0], np.int64) * num_items + np.asarray(
+            rest_x[:, 1], np.int64
+        )
+        if codes.size:
+            j = np.clip(np.searchsorted(codes, rc), 0, len(codes) - 1)
+            heldout = codes[j] != rc
+        else:
+            heldout = np.ones(len(rc), bool)
+        if heldout.sum() >= 2 * num_test:
+            rest_x, rest_y = rest_x[heldout], rest_y[heldout]
+            break
+        margin *= 2  # extremely dense configs: draw more candidates
+
+    train = RatingDataset(train_x, train_y)
+    valid = RatingDataset(rest_x[:num_test], rest_y[:num_test])
+    test = RatingDataset(
+        rest_x[num_test : 2 * num_test], rest_y[num_test : 2 * num_test]
     )
-    train = RatingDataset(full.x[: num_train], full.y[: num_train])
-    valid = RatingDataset(
-        full.x[num_train : num_train + num_test], full.y[num_train : num_train + num_test]
-    )
-    test = RatingDataset(full.x[num_train + num_test :], full.y[num_train + num_test :])
     return {"train": train, "validation": valid, "test": test}

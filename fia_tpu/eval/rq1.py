@@ -48,13 +48,24 @@ def test_retraining(
     random_seed: int = 17,
     clamp: float = 1.0,
     lane_chunk: int = 32,
+    verbose: bool = True,
 ) -> RetrainResult:
     """Run the RQ1 experiment for one test point.
 
     remove_type: 'maxinf' picks the |influence|-largest related rows
     (reference ``experiments.py:36-48``); 'random' samples uniformly from
     the related set.
+
+    ``verbose`` prints stage-boundary progress: full-protocol runs are
+    hours of silent device work otherwise (hundreds of chunked
+    retraining dispatches over a tunnel-attached chip).
     """
+    import time
+
+    def stage(msg):
+        if verbose:
+            print(f"rq1[{time.strftime('%H:%M:%S')}] test {test_idx}: {msg}",
+                  flush=True)
     model = engine.model
     params0 = engine.params
     rng = np.random.default_rng(random_seed)
@@ -63,9 +74,12 @@ def test_retraining(
     res = engine.query_batch(point[None, :])
     scores = res.scores_of(0)
     related = res.related_of(0)
+    stage(f"influence query done ({len(related)} related rows)")
 
     if remove_type == "maxinf":
-        sel = np.argsort(np.abs(scores))[-num_to_remove:][::-1].copy()
+        # descending |influence|, first num_to_remove — a [-n:] slice
+        # would select EVERYTHING for n=0
+        sel = np.argsort(np.abs(scores))[::-1][:num_to_remove].copy()
     elif remove_type == "random":
         sel = rng.choice(len(related), size=min(num_to_remove, len(related)),
                          replace=False)
@@ -102,13 +116,17 @@ def test_retraining(
         [all_seeds, np.full(pad_lanes, random_seed, all_seeds.dtype)]
     )
     chunks = []
-    for c in range(0, len(padded_removed), lane_chunk):
+    n_chunks = len(padded_removed) // lane_chunk
+    stage(f"retraining {len(all_removed)} lanes x {num_steps} steps "
+          f"({n_chunks} chunks of {lane_chunk})")
+    for ci, c in enumerate(range(0, len(padded_removed), lane_chunk)):
         params_stack = loo_retrain_many(
             model, params0, train.x, train.y, padded_removed[c : c + lane_chunk],
             num_steps=num_steps, batch_size=batch_size,
             learning_rate=learning_rate, seeds=padded_seeds[c : c + lane_chunk],
         )
         chunks.append(np.asarray(pred_fn(params_stack)))
+        stage(f"retrain chunk {ci + 1}/{n_chunks} done")
     preds = np.concatenate(chunks)[: len(all_removed)]
     preds = preds.reshape(len(lanes), retrain_times)
 

@@ -194,6 +194,71 @@ class Trainer:
         return self.fit(state, x, y, weights=weights, num_steps=num_steps)
 
 
+_LOO_ADV_CACHE = {}
+
+
+def _loo_advance_fn(model, n, nb, batch_size, num_steps, learning_rate):
+    """Compiled vmapped lane-advance, cached across calls.
+
+    ``loo_retrain_many`` is invoked once per lane chunk (eval/rq1.py) —
+    defining + jitting the closure inside it would recompile an
+    identical-shape program for every chunk of every test point.
+    Keyed by everything the closure captures; x/y are call arguments.
+    """
+    key = (model, n, nb, batch_size, num_steps, learning_rate)
+    if key in _LOO_ADV_CACHE:
+        return _LOO_ADV_CACHE[key]
+    opt = optax.adam(learning_rate)
+
+    def advance(params, opt_state, t, ridx, keys_seg, x, y):
+        """One lane, one dispatch segment: scan over keys_seg epochs.
+        Steps past num_steps are masked no-ops, so padded epochs in the
+        final segment leave params untouched."""
+        w = jnp.ones((n,), jnp.float32).at[
+            jnp.clip(ridx, 0, n - 1)
+        ].set(jnp.where(ridx >= 0, 0.0, 1.0))
+
+        def epoch(carry, ekey):
+            params, opt_state, t = carry
+            perm = jax.random.permutation(ekey, n)[: nb * batch_size]
+            sched = perm.reshape(nb, batch_size)
+
+            def step(carry, idx):
+                params, opt_state, t = carry
+                loss, g = jax.value_and_grad(model.loss)(
+                    params, x[idx], y[idx], w[idx]
+                )
+                updates, new_opt = opt.update(g, opt_state, params)
+                new_params = optax.apply_updates(params, updates)
+                take = t < num_steps
+                params = jax.tree_util.tree_map(
+                    lambda a, b: jnp.where(take, b, a), params, new_params
+                )
+                opt_state = jax.tree_util.tree_map(
+                    lambda a, b: jnp.where(take, b, a), opt_state, new_opt
+                )
+                return (params, opt_state, t + 1), loss
+
+            (params, opt_state, t), _ = jax.lax.scan(
+                step, (params, opt_state, t), sched
+            )
+            return (params, opt_state, t), None
+
+        (params, opt_state, t), _ = jax.lax.scan(
+            epoch, (params, opt_state, t), keys_seg
+        )
+        return params, opt_state, t
+
+    # donate the lane stacks: each segment's params/opt buffers alias the
+    # previous one's instead of doubling peak HBM at every boundary
+    adv = jax.jit(
+        jax.vmap(advance, in_axes=(0, 0, 0, 0, 0, None, None)),
+        donate_argnums=(0, 1, 2),
+    )
+    _LOO_ADV_CACHE[key] = adv
+    return adv
+
+
 def loo_retrain_many(
     model,
     params0,
@@ -231,43 +296,6 @@ def loo_retrain_many(
     else:
         seeds = jnp.asarray(seeds, jnp.uint32)
 
-    def advance(params, opt_state, t, ridx, keys_seg):
-        """One lane, one dispatch segment: scan over keys_seg epochs.
-        Steps past num_steps are masked no-ops, so padded epochs in the
-        final segment leave params untouched."""
-        w = jnp.ones((n,), jnp.float32).at[
-            jnp.clip(ridx, 0, n - 1)
-        ].set(jnp.where(ridx >= 0, 0.0, 1.0))
-
-        def epoch(carry, ekey):
-            params, opt_state, t = carry
-            perm = jax.random.permutation(ekey, n)[: nb * batch_size]
-            sched = perm.reshape(nb, batch_size)
-
-            def step(carry, idx):
-                params, opt_state, t = carry
-                loss, g = jax.value_and_grad(model.loss)(
-                    params, x[idx], y[idx], w[idx]
-                )
-                updates, new_opt = opt.update(g, opt_state, params)
-                new_params = optax.apply_updates(params, updates)
-                take = t < num_steps
-                params = jax.tree_util.tree_map(
-                    lambda a, b: jnp.where(take, b, a), params, new_params
-                )
-                opt_state = jax.tree_util.tree_map(
-                    lambda a, b: jnp.where(take, b, a), opt_state, new_opt
-                )
-                return (params, opt_state, t + 1), loss
-
-            (params, opt_state, t), _ = jax.lax.scan(step, (params, opt_state, t), sched)
-            return (params, opt_state, t), None
-
-        (params, opt_state, t), _ = jax.lax.scan(
-            epoch, (params, opt_state, t), keys_seg
-        )
-        return params, opt_state, t
-
     n_epochs = -(-num_steps // nb)
     # Long vmapped training programs must be split across dispatches:
     # a single many-minute device program can exceed worker/interconnect
@@ -282,11 +310,7 @@ def loo_retrain_many(
         lambda s: jax.random.split(jax.random.PRNGKey(s), n_epochs)
     )(seeds)  # (R, n_epochs, 2)
 
-    # donate the lane stacks: each segment's params/opt buffers alias the
-    # previous one's instead of doubling peak HBM at every boundary
-    adv = jax.jit(
-        jax.vmap(advance, in_axes=(0, 0, 0, 0, 0)), donate_argnums=(0, 1, 2)
-    )
+    adv = _loo_advance_fn(model, n, nb, batch_size, num_steps, learning_rate)
     R = removed.shape[0]
     params = jax.tree_util.tree_map(
         lambda l: jnp.broadcast_to(l, (R, *l.shape)), params0
@@ -299,6 +323,6 @@ def loo_retrain_many(
     # rather than a padded segment of masked no-op steps
     for start in range(0, n_epochs, seg_epochs):
         seg = keys[:, start : start + seg_epochs]
-        params, opt_state, t = adv(params, opt_state, t, removed, seg)
+        params, opt_state, t = adv(params, opt_state, t, removed, seg, x, y)
         jax.block_until_ready(t)
     return params

@@ -22,8 +22,31 @@ def fenced_time(fn, *args, **kwargs):
     return out, time.perf_counter() - t0
 
 
+def _flush_device_queue():
+    """Block until previously dispatched device programs finish.
+
+    ``jax.effects_barrier()`` only waits for *side-effecting*
+    computations, so it does not fence ordinary async dispatch. Compiled
+    programs execute in dispatch order per device, so blocking on a
+    freshly dispatched trivial computation drains the queue.
+    """
+    import jax.numpy as jnp
+
+    jax.block_until_ready(jax.jit(lambda: jnp.zeros(()))())
+
+
 class Timer:
-    """Named section timer: ``with timer('solve'): ...``; .report() dict."""
+    """Named section timer: ``with timer('solve'): ...``; .report() dict.
+
+    ``fence=True`` drains the device queue before stopping the clock
+    (async dispatch otherwise records only dispatch time). For exact
+    fencing on a specific result, call ``.fence(out)`` on the yielded
+    handle instead: ``with timer('x') as t: t.fence(f())``.
+    """
+
+    class _Section:
+        def fence(self, value):
+            return jax.block_until_ready(value)
 
     def __init__(self):
         self.sections: dict[str, float] = {}
@@ -31,10 +54,9 @@ class Timer:
     @contextlib.contextmanager
     def __call__(self, name: str, fence: bool = False):
         t0 = time.perf_counter()
-        yield
+        yield Timer._Section()
         if fence:
-            # fence everything outstanding on the default backend
-            jax.effects_barrier()
+            _flush_device_queue()
         self.sections[name] = self.sections.get(name, 0.0) + time.perf_counter() - t0
 
     def report(self) -> dict[str, float]:

@@ -16,28 +16,32 @@
 
 extern "C" {
 
-// Count data rows (non-empty lines) in a ratings TSV file.
-// Returns -1 on IO error.
+// Count data rows in a ratings TSV file: lines whose first non-blank
+// character is a digit (headers/comments are not data — the parser
+// skips them, and the two must agree). Returns -1 on IO error.
 int64_t fia_count_rows(const char* path) {
     FILE* f = std::fopen(path, "rb");
     if (!f) return -1;
     constexpr size_t BUF = 1 << 20;
     char* buf = static_cast<char*>(std::malloc(BUF));
     int64_t rows = 0;
-    bool line_has_data = false;
+    bool at_line_start = true;
+    bool line_is_data = false;
     size_t got;
     while ((got = std::fread(buf, 1, BUF, f)) > 0) {
         for (size_t i = 0; i < got; ++i) {
             char c = buf[i];
             if (c == '\n') {
-                if (line_has_data) ++rows;
-                line_has_data = false;
-            } else if (c != '\r' && c != ' ' && c != '\t') {
-                line_has_data = true;
+                if (line_is_data) ++rows;
+                at_line_start = true;
+                line_is_data = false;
+            } else if (at_line_start && c != '\r' && c != ' ' && c != '\t') {
+                line_is_data = (c >= '0' && c <= '9');
+                at_line_start = false;
             }
         }
     }
-    if (line_has_data) ++rows;
+    if (line_is_data) ++rows;
     std::free(buf);
     std::fclose(f);
     return rows;
@@ -67,31 +71,47 @@ int64_t fia_parse_tsv(const char* path, int64_t max_rows,
         while (p < end && (*p == ' ' || *p == '\t' || *p == '\r' || *p == '\n'))
             ++p;
         if (p >= end) break;
-        // user
+        // user — a line not starting with digits (header, comment) is
+        // skipped, never emitted as a spurious (0, 0, 0.0) row
         int64_t u = 0;
-        while (p < end && *p >= '0' && *p <= '9') u = u * 10 + (*p++ - '0');
+        int u_digits = 0;
+        while (p < end && *p >= '0' && *p <= '9') {
+            u = u * 10 + (*p++ - '0');
+            ++u_digits;
+        }
         while (p < end && (*p == ' ' || *p == '\t')) ++p;
         // item
         int64_t it = 0;
-        while (p < end && *p >= '0' && *p <= '9') it = it * 10 + (*p++ - '0');
+        int i_digits = 0;
+        while (p < end && *p >= '0' && *p <= '9') {
+            it = it * 10 + (*p++ - '0');
+            ++i_digits;
+        }
         while (p < end && (*p == ' ' || *p == '\t')) ++p;
         // rating (int or decimal)
         double r = 0.0;
+        int r_digits = 0;
         bool neg = false;
         if (p < end && (*p == '-' || *p == '+')) neg = (*p++ == '-');
-        while (p < end && *p >= '0' && *p <= '9') r = r * 10 + (*p++ - '0');
+        while (p < end && *p >= '0' && *p <= '9') {
+            r = r * 10 + (*p++ - '0');
+            ++r_digits;
+        }
         if (p < end && *p == '.') {
             ++p;
             double scale = 0.1;
             while (p < end && *p >= '0' && *p <= '9') {
                 r += (*p++ - '0') * scale;
                 scale *= 0.1;
+                ++r_digits;
             }
         }
-        users[n] = static_cast<int32_t>(u);
-        items[n] = static_cast<int32_t>(it);
-        ratings[n] = static_cast<float>(neg ? -r : r);
-        ++n;
+        if (u_digits && i_digits && r_digits) {
+            users[n] = static_cast<int32_t>(u);
+            items[n] = static_cast<int32_t>(it);
+            ratings[n] = static_cast<float>(neg ? -r : r);
+            ++n;
+        }
         while (p < end && *p != '\n') ++p;  // skip rest of line
     }
     std::free(data);

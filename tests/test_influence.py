@@ -325,6 +325,21 @@ class TestEngine:
                 flat.scores_of(t), padded.scores_of(t), rtol=1e-3, atol=1e-5
             )
 
+    def test_flat_chunk_is_inert(self, model_cls):
+        """The Hessian-accumulation chunk size is a pure performance
+        knob — results must not depend on it."""
+        model, params, train = _setup(model_cls)
+        pts = np.array([[3, 5], [0, 1]], np.int32)
+        base = InfluenceEngine(model, params, train, damping=DAMP,
+                               impl="flat").query_batch(pts)
+        small = InfluenceEngine(model, params, train, damping=DAMP,
+                                impl="flat", flat_chunk=256).query_batch(pts)
+        np.testing.assert_allclose(base.ihvp, small.ihvp, rtol=1e-5, atol=1e-7)
+        for t in range(len(pts)):
+            np.testing.assert_allclose(
+                base.scores_of(t), small.scores_of(t), rtol=1e-5, atol=1e-7
+            )
+
     def test_zero_related_query(self, model_cls):
         """A query whose user and item never appear in training has an
         empty related set: no scores, finite ihvp (pure reg+damping

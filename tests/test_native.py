@@ -40,6 +40,16 @@ class TestNative:
         assert u.tolist() == [0, 2] and i.tolist() == [1, 3]
         np.testing.assert_allclose(r, [3.5, 4.25])
 
+    def test_parse_tsv_skips_header_lines(self, built, tmp_path):
+        """Non-numeric lines (headers, comments) must be skipped, not
+        parsed into spurious (0, 0, 0.0) rows."""
+        p = tmp_path / "h.rating"
+        p.write_text("user\titem\trating\n1\t2\t5\n# comment\n3\t4\t2.5\n")
+        u, i, r = native.parse_tsv(str(p))
+        np.testing.assert_array_equal(u, [1, 3])
+        np.testing.assert_array_equal(i, [2, 4])
+        np.testing.assert_allclose(r, [5.0, 2.5])
+
     def test_build_csr_matches_numpy(self, built):
         rng = np.random.default_rng(1)
         ids = rng.integers(0, 50, 5000).astype(np.int32)
