@@ -49,6 +49,11 @@ def base_parser(desc: str) -> argparse.ArgumentParser:
                    help="training rows removed per test point for RQ1 "
                         "ground truth (experiments.py:18 default; the "
                         "reference RQ1 driver passes 1)")
+    p.add_argument("--lane_chunk", type=int, default=32,
+                   help="LOO retraining lanes per device dispatch; lower "
+                        "for big models on fragile tunnel workers")
+    p.add_argument("--steps_per_dispatch", type=int, default=2000,
+                   help="max retraining steps per device dispatch")
     p.add_argument("--sort_test_case", type=int, default=0,
                    help="1: pick the least-supported test points")
     # framework knobs

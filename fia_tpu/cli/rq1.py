@@ -55,6 +55,8 @@ def main(argv=None):
             learning_rate=args.lr,
             retrain_times=args.retrain_times,
             remove_type="maxinf" if args.maxinf else "random",
+            lane_chunk=args.lane_chunk,
+            steps_per_dispatch=args.steps_per_dispatch,
         )
         r = pearson(res.actual_y_diffs, res.predicted_y_diffs)
         print(f"test {int(t)}: pearson r = {r:.4f} "

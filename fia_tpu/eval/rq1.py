@@ -48,6 +48,7 @@ def test_retraining(
     random_seed: int = 17,
     clamp: float = 1.0,
     lane_chunk: int = 32,
+    steps_per_dispatch: int = 2000,
     verbose: bool = True,
 ) -> RetrainResult:
     """Run the RQ1 experiment for one test point.
@@ -124,6 +125,7 @@ def test_retraining(
             model, params0, train.x, train.y, padded_removed[c : c + lane_chunk],
             num_steps=num_steps, batch_size=batch_size,
             learning_rate=learning_rate, seeds=padded_seeds[c : c + lane_chunk],
+            steps_per_dispatch=steps_per_dispatch,
         )
         chunks.append(np.asarray(pred_fn(params_stack)))
         stage(f"retrain chunk {ci + 1}/{n_chunks} done")
