@@ -63,12 +63,15 @@ class Trainer:
     def _make_epoch_fn(self, n_rows: int, n_batches: int, batch: int):
         model, opt = self.model, self.optimizer
 
-        def epoch(params, opt_state, x, y, w, key, limit):
+        def epoch(params, opt_state, x, y, w, key, start, limit):
             """One epoch: scan over a fresh device-side permutation.
 
-            ``limit`` masks trailing steps so the final partial epoch
-            reuses the same compiled function. ``w`` is an (N,) row-weight
-            vector (1s normally; 0 on rows removed for retraining).
+            ``start``/``limit`` mask leading/trailing steps so partial
+            epochs (a resume landing mid-epoch, or a final short epoch)
+            reuse the same compiled function while replaying exactly the
+            batches a fresh uninterrupted run would have used. ``w`` is
+            an (N,) row-weight vector (1s normally; 0 on rows removed
+            for retraining).
             """
             perm = jax.random.permutation(key, n_rows)[: n_batches * batch]
             sched = perm.reshape(n_batches, batch)
@@ -79,7 +82,7 @@ class Trainer:
                 loss, g = jax.value_and_grad(model.loss)(params, bx, by, bw)
                 updates, new_opt = opt.update(g, opt_state, params)
                 new_params = optax.apply_updates(params, updates)
-                take = t < limit
+                take = jnp.logical_and(t >= start, t < limit)
                 params = jax.tree_util.tree_map(
                     lambda a, b: jnp.where(take, b, a), params, new_params
                 )
@@ -158,21 +161,27 @@ class Trainer:
 
         done = 0
         key = jax.random.PRNGKey(cfg.seed)
-        epoch_i = 0
+        # continue the epoch key stream from the state's absolute step —
+        # a resumed run (any alignment, thanks to the leading-step mask)
+        # replays the exact batch schedule a fresh run would have used
         while done < mini_steps:
-            todo = min(nb, mini_steps - done)
+            abs_step = state.step + done
+            epoch_i = abs_step // nb
+            r = abs_step % nb
+            todo = min(nb - r, mini_steps - done)
             ekey = jax.random.fold_in(key, epoch_i)
             params, opt_state, losses = epoch_fn(
-                params, opt_state, x, y, w, ekey, jnp.int32(todo)
+                params, opt_state, x, y, w, ekey,
+                jnp.int32(r), jnp.int32(r + todo),
             )
             done += todo
-            epoch_i += 1
-            if cfg.log_every and (epoch_i % max(1, cfg.log_every // nb) == 0):
-                print(f"step {state.step + done}: loss = {float(losses[todo - 1]):.6f}")
+            if cfg.log_every and ((epoch_i + 1) % max(1, cfg.log_every // nb) == 0):
+                print(f"step {state.step + done}: "
+                      f"loss = {float(losses[r + todo - 1]):.6f}")
             if self.event_log is not None:
                 self.event_log.log(
                     "train_epoch", epoch=epoch_i, step=state.step + done,
-                    loss=float(losses[todo - 1]),
+                    loss=float(losses[r + todo - 1]),
                 )
 
         if batch_steps > 0:

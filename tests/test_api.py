@@ -61,6 +61,37 @@ class TestFacade:
         for g in out:
             assert set(g) == {"pu", "qi", "bu", "bi"}
 
+    def test_resume_preserves_phase_schedule(self, tiny_splits, tmp_path):
+        """train(load_checkpoints=..) must reproduce a fresh run's phase
+        schedule: switch thresholds are absolute step indices, so the
+        resumed segment has to shift them by the steps already done."""
+        def fresh(train_dir, name):
+            train = tiny_splits["train"]
+            return FIAModel(
+                model="MF", num_users=train.num_users,
+                num_items=train.num_items, embedding_size=4,
+                weight_decay=1e-3, batch_size=200,
+                data_sets=tiny_splits, initial_learning_rate=1e-2,
+                train_dir=str(train_dir), model_name=name,
+            )
+
+        # switches: minibatch until 25, full-batch Adam 25-32, SGD 32-40
+        kw = dict(iter_to_switch_to_batch=25, iter_to_switch_to_sgd=32)
+        a = fresh(tmp_path, "fresh")
+        a.train(num_steps=40, verbose=False, **kw)
+
+        # resume from a NON-epoch-aligned checkpoint (17 % nb(=10) != 0):
+        # the leading-step mask must skip the 7 already-trained batches
+        # of epoch 1 instead of re-applying them
+        b = fresh(tmp_path, "resumed")
+        b.train(num_steps=17, verbose=False)
+        b.train(num_steps=40, verbose=False, load_checkpoints=16, **kw)
+        for k in a.params:
+            np.testing.assert_allclose(
+                np.asarray(a.params[k]), np.asarray(b.params[k]),
+                rtol=1e-5, atol=1e-6,
+            )
+
     def test_update_datasets(self, fia, tiny_splits):
         n = fia.num_train_examples
         tr = tiny_splits["train"]
