@@ -237,6 +237,34 @@ class TestSolvers:
         for x, v in zip(xs, vs):
             np.testing.assert_allclose(H @ x, v, rtol=1e-3, atol=1e-3)
 
+    def test_lissa_multi_sample_decorrelated(self):
+        """num_samples > 1 must average DISTINCT stochastic recursions:
+        the sample index offsets the minibatch sequence (the reference
+        re-draws per repetition), so the 2-sample mean equals the mean of
+        the two single runs at offset index ranges — not sample 0 twice."""
+        d = 6
+        H = jnp.eye(d) * jnp.linspace(0.5, 3.0, d)
+        v = jnp.ones(d)
+        depth = 50
+
+        def sample_hvp(j, x):
+            # index-dependent perturbation stands in for minibatch noise
+            return H @ x * (1.0 + 0.01 * jnp.cos(jnp.float32(j)))
+
+        two = solvers.solve_lissa(lambda w: H @ w, v, scale=10.0,
+                                  recursion_depth=depth, num_samples=2,
+                                  sample_hvp=sample_hvp)
+        one_a = solvers.solve_lissa(lambda w: H @ w, v, scale=10.0,
+                                    recursion_depth=depth, num_samples=1,
+                                    sample_hvp=sample_hvp)
+        one_b = solvers.solve_lissa(
+            lambda w: H @ w, v, scale=10.0, recursion_depth=depth,
+            num_samples=1, sample_hvp=lambda j, x: sample_hvp(j + depth, x),
+        )
+        assert not np.allclose(one_a, one_b)  # samples genuinely differ
+        np.testing.assert_allclose(two, (one_a + one_b) / 2.0,
+                                   rtol=1e-5, atol=1e-7)
+
     def test_lissa_converges(self):
         # LiSSA needs ||H/scale|| < 1
         d = 6
