@@ -61,6 +61,7 @@ def main():
     _ensure_live_backend()
     import jax
 
+    from fia_tpu.utils.logging import EventLog
     from fia_tpu.backends.torch_ref import TorchRefMFEngine, TorchRefNCFEngine
     from fia_tpu.data.synthetic import sample_heldout_pairs, synthesize_ratings
     from fia_tpu.eval.metrics import spearman
@@ -83,6 +84,8 @@ def main():
         lr = 1e-3
     k, wd, damping, batch = 16, 1e-3, 1e-6, 3020
 
+    log = EventLog(os.path.join("output", "events-bench.jsonl"))
+    log.log("run_start", quick=QUICK, backend=jax.default_backend())
     _stage(f"backend={jax.default_backend()} devices={jax.device_count()}")
     train = synthesize_ratings(users, items, rows, seed=0)
     model = MF(users, items, k, wd)
@@ -91,7 +94,7 @@ def main():
     # brief training so the block Hessians look like the real workload's
     _stage(f"training: {steps} steps on {rows} rows")
     tr = Trainer(model, TrainConfig(batch_size=batch, num_steps=steps,
-                                    learning_rate=lr))
+                                    learning_rate=lr), event_log=log)
     state = tr.fit(tr.init_state(params), train.x, train.y)
     params = state.params
     _stage("training done; building influence engine")
@@ -104,6 +107,7 @@ def main():
 
     _stage(f"timing {n_queries} influence queries")
     timing = time_influence_queries(engine, points, repeats=3)
+    log.log("query_batch", model="MF", **timing.json())
     _stage(f"jax path done ({timing.scores_per_sec:.0f} scores/s); "
            f"running CPU reference on {n_base} queries")
 
@@ -150,6 +154,7 @@ def main():
                                      pad_bucket=512, model_name="ncf")
         _stage(f"NCF stage: timing {ncf_q} queries")
         ncf_timing = time_influence_queries(ncf_engine, points[:ncf_q], repeats=3)
+        log.log("query_batch", model="NCF", **ncf_timing.json())
         ncf_host = jax.tree_util.tree_map(np.asarray, ncf_state.params)
         ncf_ref = TorchRefNCFEngine(ncf_host, train.x, train.y,
                                     weight_decay=wd, damping=damping,
@@ -189,6 +194,8 @@ def main():
             "ncf": ncf_out,
         },
     }
+    log.log("run_done", value=out["value"], vs_baseline=out["vs_baseline"])
+    log.close()
     print(json.dumps(out))
 
 

@@ -77,7 +77,10 @@ class FIAModel:
         )
         params = model.init_params(jax.random.PRNGKey(seed))
         self.state = self._trainer.init_state(params)
-        self._engine = None  # rebuilt lazily after params/train-set change
+        # engines keyed by solve configuration, rebuilt lazily after
+        # params/train-set changes; keeping every configuration alive
+        # preserves its compiled queries across a solver sweep
+        self._engines: dict = {}
 
     # -- properties --------------------------------------------------------
     @property
@@ -91,18 +94,20 @@ class FIAModel:
     def _checkpoint_path(self, step: int) -> str:
         return os.path.join(self.train_dir, f"{self.model_name}-checkpoint-{step}")
 
-    def engine(self) -> InfluenceEngine:
-        if self._engine is None:
-            self._engine = InfluenceEngine(
+    def engine(self, solver: str | None = None, **extra) -> InfluenceEngine:
+        key = (solver or self.solver, tuple(sorted(extra.items())))
+        eng = self._engines.get(key)
+        if eng is None:
+            eng = self._engines[key] = InfluenceEngine(
                 self.model, self.state.params, self.data_sets["train"],
-                damping=self.damping, solver=self.solver,
+                damping=self.damping, solver=solver or self.solver,
                 cache_dir=self.train_dir, model_name=self.model_name,
-                mesh=self.mesh,
+                mesh=self.mesh, **extra,
             )
-        return self._engine
+        return eng
 
     def _invalidate(self):
-        self._engine = None
+        self._engines.clear()
 
     # -- training (genericNeuralNet.py:367-449) ----------------------------
     def train(self, num_steps: int, iter_to_switch_to_batch: int | None = None,
@@ -198,13 +203,11 @@ class FIAModel:
             )
         if (approx_type and approx_type != eng.solver) or approx_params:
             # approx_params keys are InfluenceEngine kwargs
-            # (cg_maxiter, cg_tol, lissa_scale, lissa_depth, ...)
-            eng = InfluenceEngine(
-                self.model, self.state.params, self.data_sets["train"],
-                damping=self.damping, solver=approx_type or eng.solver,
-                cache_dir=self.train_dir, model_name=self.model_name,
-                mesh=self.mesh, **(approx_params or {}),
-            )
+            # (cg_maxiter, cg_tol, lissa_scale, lissa_depth, ...);
+            # engine() caches per configuration, so sweeping solvers
+            # reuses each one's compiled queries instead of rebuilding
+            eng = self.engine(approx_type or eng.solver,
+                              **(approx_params or {}))
         return eng.get_influence_on_test_loss(
             test_indices, self.data_sets["test"],
             force_refresh=force_refresh, test_description=test_description,

@@ -62,6 +62,26 @@ def base_parser(desc: str) -> argparse.ArgumentParser:
                    help="force a JAX platform (default: auto)")
     p.add_argument("--solver", type=str, default="direct",
                    choices=["direct", "cg", "lissa", "schulz"])
+    p.add_argument("--cg_maxiter", type=int, default=100,
+                   help="CG iteration cap (reference fmin_ncg maxiter, "
+                        "matrix_factorization.py:431)")
+    p.add_argument("--lissa_depth", type=int, default=10_000,
+                   help="LiSSA recursion depth (reference default, "
+                        "genericNeuralNet.py:544)")
+    p.add_argument("--lissa_scale", type=float, default=10.0,
+                   help="LiSSA scale (reference genericNeuralNet.py:511)")
+    p.add_argument("--impl", type=str, default="auto",
+                   choices=["auto", "flat", "padded"],
+                   help="query implementation: flat segment-sum or "
+                        "padded per-query vmap")
+    p.add_argument("--use_pallas", type=int, default=0,
+                   help="1: fused Pallas scoring kernel (MF only)")
+    p.add_argument("--mesh", type=int, default=0,
+                   help="shard query batches, training and LOO retraining "
+                        "over an N-device 'data' mesh (0 = single device)")
+    p.add_argument("--log_file", type=str, default="auto",
+                   help="JSONL event log path; 'auto' derives one under "
+                        "--train_dir, 'none' disables")
     p.add_argument("--pad_policy", type=str, default="batch",
                    choices=["batch", "dataset"],
                    help="pad queries to the batch max (least compute) or "
@@ -77,6 +97,52 @@ def base_parser(desc: str) -> argparse.ArgumentParser:
     p.add_argument("--synth_train", type=int, default=50_000)
     p.add_argument("--synth_test", type=int, default=500)
     return p
+
+
+def engine_kwargs(args) -> dict:
+    """Solver/impl engine kwargs shared by every driver."""
+    return dict(
+        damping=args.damping,
+        solver=args.solver,
+        pad_policy=args.pad_policy,
+        cg_tol=cg_tol_for(args),
+        cg_maxiter=args.cg_maxiter,
+        lissa_depth=args.lissa_depth,
+        lissa_scale=args.lissa_scale,
+        impl=args.impl,
+        use_pallas=bool(args.use_pallas),
+    )
+
+
+def mesh_for(args):
+    """A 1-D 'data' Mesh over the first --mesh devices (None when 0)."""
+    if not getattr(args, "mesh", 0):
+        return None
+    import jax
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    if len(devs) < args.mesh:
+        raise SystemExit(
+            f"--mesh {args.mesh} requested but only {len(devs)} devices "
+            "are visible (set XLA_FLAGS=--xla_force_host_platform_device_"
+            "count=N for a virtual CPU mesh)"
+        )
+    return Mesh(np.asarray(devs[: args.mesh]), ("data",))
+
+
+def event_log_for(args, driver: str):
+    """EventLog from --log_file ('auto' derives a per-run path)."""
+    from fia_tpu.utils.logging import EventLog
+
+    path = args.log_file
+    if path == "none":
+        path = None
+    elif path == "auto":
+        path = os.path.join(
+            args.train_dir, f"events-{driver}-{args.model}-{args.dataset}.jsonl"
+        )
+    return EventLog(path)
 
 
 def cg_tol_for(args) -> float:
@@ -144,7 +210,8 @@ def build_model(args, splits):
     return model, params
 
 
-def train_or_load(args, model, params, splits, num_steps=None, verbose=True):
+def train_or_load(args, model, params, splits, num_steps=None, verbose=True,
+                  event_log=None, mesh=None):
     """Reference RQ2.py:102-109 train-or-load behavior."""
     num_steps = num_steps or args.num_steps_train
     train = splits["train"]
@@ -152,7 +219,7 @@ def train_or_load(args, model, params, splits, num_steps=None, verbose=True):
     cfg = TrainConfig(batch_size=batch, num_steps=num_steps,
                       learning_rate=args.lr, seed=args.seed,
                       log_every=10_000 if verbose else 0)
-    trainer = Trainer(model, cfg)
+    trainer = Trainer(model, cfg, event_log=event_log, mesh=mesh)
     state = trainer.init_state(params)
 
     ckpt = os.path.join(args.train_dir, f"{model_name_for(args)}-checkpoint-{num_steps - 1}")

@@ -34,13 +34,19 @@ def main(argv=None):
           f"train={train.num_examples} test={test.num_examples} "
           f"params={model.num_params()}")
 
-    trainer, state, batch = common.train_or_load(args, model, params, splits)
+    mesh = common.mesh_for(args)
+    log = common.event_log_for(args, "rq1")
+    log.log("run_start", driver="rq1", **{
+        k: v for k, v in vars(args).items() if not k.startswith("_")
+    })
+    trainer, state, batch = common.train_or_load(
+        args, model, params, splits, event_log=log, mesh=mesh
+    )
 
     engine = InfluenceEngine(
-        model, state.params, train,
-        damping=args.damping, solver=args.solver, pad_policy=args.pad_policy,
-        cg_tol=common.cg_tol_for(args),
+        model, state.params, train, mesh=mesh,
         cache_dir=args.train_dir, model_name=common.model_name_for(args),
+        **common.engine_kwargs(args),
     )
     test_indices = common.pick_test_points(args, splits, engine.index)
     print(f"test indices: {list(map(int, test_indices))}")
@@ -57,10 +63,13 @@ def main(argv=None):
             remove_type="maxinf" if args.maxinf else "random",
             lane_chunk=args.lane_chunk,
             steps_per_dispatch=args.steps_per_dispatch,
+            mesh=mesh, event_log=log,
         )
         r = pearson(res.actual_y_diffs, res.predicted_y_diffs)
         print(f"test {int(t)}: pearson r = {r:.4f} "
               f"(bias_retrain {res.bias_retrain:+.5f})")
+        log.log("test_point_done", test_idx=int(t), pearson=float(r),
+                bias_retrain=float(res.bias_retrain))
         actuals.append(res.actual_y_diffs)
         predictions.append(res.predicted_y_diffs)
         removed.append(res.indices_to_remove)
@@ -82,6 +91,9 @@ def main(argv=None):
     a = np.concatenate(actuals)
     p = np.concatenate(predictions)
     print(f"Correlation is {pearson(a, p):.6f} (spearman {spearman(a, p):.6f})")
+    log.log("run_done", pearson=float(pearson(a, p)),
+            spearman=float(spearman(a, p)))
+    log.close()
     return pearson(a, p)
 
 

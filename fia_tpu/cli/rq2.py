@@ -28,13 +28,19 @@ def main(argv=None):
     splits = common.load_splits(args)
     train, test = splits["train"], splits["test"]
     model, params = common.build_model(args, splits)
-    trainer, state, batch = common.train_or_load(args, model, params, splits)
+    mesh = common.mesh_for(args)
+    log = common.event_log_for(args, "rq2")
+    log.log("run_start", driver="rq2", **{
+        k: v for k, v in vars(args).items() if not k.startswith("_")
+    })
+    trainer, state, batch = common.train_or_load(
+        args, model, params, splits, event_log=log, mesh=mesh
+    )
 
     engine = InfluenceEngine(
-        model, state.params, train,
-        damping=args.damping, solver=args.solver, pad_policy=args.pad_policy,
-        cg_tol=common.cg_tol_for(args),
+        model, state.params, train, mesh=mesh,
         cache_dir=args.train_dir, model_name=common.model_name_for(args),
+        **common.engine_kwargs(args),
     )
 
     rng = np.random.default_rng(args.seed + 17)
@@ -51,6 +57,9 @@ def main(argv=None):
     print(f"Total time is {timing.total_time_s} sec")
     print(json.dumps({"model": args.model, "dataset": args.dataset,
                       "embed_size": args.embed_size, **timing.json()}))
+    log.log("query_batch", model=args.model, dataset=args.dataset,
+            embed_size=args.embed_size, **timing.json())
+    log.close()
     return timing
 
 

@@ -50,6 +50,8 @@ def test_retraining(
     lane_chunk: int = 32,
     steps_per_dispatch: int = 2000,
     verbose: bool = True,
+    mesh=None,
+    event_log=None,
 ) -> RetrainResult:
     """Run the RQ1 experiment for one test point.
 
@@ -76,6 +78,9 @@ def test_retraining(
     scores = res.scores_of(0)
     related = res.related_of(0)
     stage(f"influence query done ({len(related)} related rows)")
+    if event_log is not None:
+        event_log.log("influence_query", test_idx=int(test_idx),
+                      related=int(len(related)))
 
     if remove_type == "maxinf":
         # descending |influence|, first num_to_remove — a [-n:] slice
@@ -121,14 +126,19 @@ def test_retraining(
     stage(f"retraining {len(all_removed)} lanes x {num_steps} steps "
           f"({n_chunks} chunks of {lane_chunk})")
     for ci, c in enumerate(range(0, len(padded_removed), lane_chunk)):
+        t0 = time.time()
         params_stack = loo_retrain_many(
             model, params0, train.x, train.y, padded_removed[c : c + lane_chunk],
             num_steps=num_steps, batch_size=batch_size,
             learning_rate=learning_rate, seeds=padded_seeds[c : c + lane_chunk],
-            steps_per_dispatch=steps_per_dispatch,
+            steps_per_dispatch=steps_per_dispatch, mesh=mesh,
         )
         chunks.append(np.asarray(pred_fn(params_stack)))
         stage(f"retrain chunk {ci + 1}/{n_chunks} done")
+        if event_log is not None:
+            event_log.log("retrain_chunk", test_idx=int(test_idx),
+                          chunk=ci + 1, of=n_chunks, lanes=int(lane_chunk),
+                          steps=int(num_steps), secs=round(time.time() - t0, 3))
     preds = np.concatenate(chunks)[: len(all_removed)]
     preds = preds.reshape(len(lanes), retrain_times)
 

@@ -88,7 +88,7 @@ class InfluenceEngine:
         cg_maxiter: int = 100,
         cg_tol: float = 1e-10,
         lissa_scale: float = 10.0,
-        lissa_depth: int = 1000,
+        lissa_depth: int = 10_000,  # reference depth, genericNeuralNet.py:544
         mesh: Mesh | None = None,
         cache_dir: str | None = None,
         model_name: str = "model",
@@ -113,6 +113,8 @@ class InfluenceEngine:
             self.params = shard_model_params(mesh, self.params, model)
         self.train_x = jnp.asarray(train.x)
         self.train_y = jnp.asarray(train.y)
+        # host view kept for the cache fingerprint (zero-copy refs)
+        self._train_host = (np.asarray(train.x), np.asarray(train.y))
         self._multihost = False
         if mesh is not None:
             # On a cross-process (multi-host) mesh every jit operand must
@@ -668,7 +670,7 @@ class InfluenceEngine:
                 with np.load(cache) as hit:
                     if "scores" in hit and (
                         "params_fp" in hit
-                        and np.allclose(hit["params_fp"], self._params_fingerprint())
+                        and self._fingerprint_matches(hit["params_fp"])
                     ):
                         return hit["scores"]
             except Exception:
@@ -691,20 +693,54 @@ class InfluenceEngine:
         sharded embedding tables aren't gathered to host just for two
         scalars) plus the solve configuration — the cache filename keys
         the solver name but not damping/tolerances, and stale scores
-        from a different solve setup must not be served."""
+        from a different solve setup must not be served. The training
+        set is fingerprinted too (row count plus position-weighted x/y
+        checksums): identical params over different train data — e.g. a
+        leave-one-out subset — must not serve each other's scores."""
         if getattr(self, "_params_fp", None) is None:
             stats = [
                 s
                 for leaf in jax.tree_util.tree_leaves(self.params)
                 for s in (jnp.sum(leaf), jnp.linalg.norm(jnp.ravel(leaf)))
             ]
+            # Train-set checksums are computed on HOST in float64 with
+            # np.sum (pairwise, BLAS-free, deterministic) and compared
+            # EXACTLY: at ML-scale the position-weighted dots are ~1e14,
+            # where any relative tolerance swallows a one-row delta —
+            # the exact case (LOO subset vs full set) this guards.
+            hx, hy = self._train_host
+            n = hx.shape[0]
+            pos = ((np.arange(n) % 997) + 1).astype(np.float64)
+            tstats = [
+                float(n),
+                float(np.sum(hx[:, 0].astype(np.float64) * pos)),
+                float(np.sum(hx[:, 1].astype(np.float64) * pos)),
+                float(np.sum(hy.astype(np.float64) * pos)),
+            ]
             cfg = [self.damping, self.cg_tol, float(self.cg_maxiter),
                    self.lissa_scale, float(self.lissa_depth)]
             self._params_fp = np.concatenate([
                 np.asarray(jax.device_get(jnp.stack(stats)), np.float64),
-                np.asarray(cfg, np.float64),
+                np.asarray(tstats + cfg, np.float64),
             ])
         return self._params_fp
+
+    # train stats + solve cfg at the fingerprint tail (exact-match part)
+    _FP_EXACT_TAIL = 9
+
+    def _fingerprint_matches(self, stored) -> bool:
+        """Params stats tolerate cross-backend reduction noise
+        (allclose); train checksums and solve config must match EXACTLY
+        (see _params_fingerprint on why tolerances would unguard LOO)."""
+        fp = self._params_fingerprint()
+        stored = np.asarray(stored)
+        if stored.shape != fp.shape:
+            return False
+        k = fp.shape[0] - self._FP_EXACT_TAIL
+        return bool(
+            np.allclose(stored[:k], fp[:k])
+            and np.array_equal(stored[k:], fp[k:])
+        )
 
     def related_indices(self, test_point) -> np.ndarray:
         u, i = int(test_point[0]), int(test_point[1])

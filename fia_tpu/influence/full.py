@@ -41,7 +41,7 @@ class FullInfluenceEngine:
         cg_maxiter: int = 100,
         cg_tol: float = 1e-8,
         lissa_scale: float = 10.0,
-        lissa_depth: int = 1000,
+        lissa_depth: int = 10_000,  # reference depth, genericNeuralNet.py:544
         lissa_batch: int = 0,  # 0 = full-batch HVPs inside LiSSA
         mesh: Mesh | None = None,
     ):

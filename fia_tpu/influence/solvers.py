@@ -6,8 +6,9 @@ The reference solves H x = v by minimising the quadratic
 (``genericNeuralNet.py:511-544``). The system here is PSD (damped
 Gauss-Newton-ish block Hessian), so:
 
-  - ``solve_direct``: materialise the tiny block Hessian and Cholesky-
-    solve. Exact; the TPU-fast default for FIA blocks (d = 2k+2 or 4k).
+  - ``solve_direct``: materialise the tiny block Hessian and LU-solve
+    (see its docstring for why not Cholesky). Exact; the TPU-fast
+    default for FIA blocks (d = 2k+2 or 4k).
   - ``solve_cg``: matrix-free conjugate gradients under ``lax.while_loop``
     (device-resident; equivalent to fmin_ncg's quadratic minimisation in
     exact arithmetic). For large d / full-parameter systems.

@@ -187,9 +187,26 @@ def process_local_rows(
             for d, idx in sharding.devices_indices_map((n_global,)).items()
             if d.process_index == me
         ]
-        starts = [0 if s.start is None else s.start for s in spans]
-        stops = [n_global if s.stop is None else s.stop for s in spans]
-        return slice(min(starts), max(stops))
+        # distinct spans only: along a replicated second axis (2-D mesh)
+        # many local devices own the SAME row range
+        uniq = {
+            (0 if s.start is None else s.start,
+             n_global if s.stop is None else s.stop)
+            for s in spans
+        }
+        lo = min(a for a, _ in uniq)
+        hi = max(b for _, b in uniq)
+        if hi - lo != sum(b - a for a, b in uniq):
+            # e.g. a mesh permutation interleaving this process's devices
+            # with another's — a single slice would cover foreign rows
+            raise ValueError(
+                "this process's shard spans along the "
+                f"'{axis}' axis are not contiguous ({sorted(uniq)}); "
+                "process_local_rows cannot represent them as one slice — "
+                "use a device-order mesh layout (make_hybrid_mesh) or feed "
+                "rows per-device"
+            )
+        return slice(lo, hi)
     p, np_ = jax.process_index(), jax.process_count()
     base, extra = divmod(n_global, np_)
     start = p * base + min(p, extra)

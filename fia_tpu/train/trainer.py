@@ -42,12 +42,20 @@ class TrainState:
 
 
 class Trainer:
-    def __init__(self, model, config: TrainConfig, event_log=None):
+    """Minibatch trainer; with ``mesh`` (a Mesh with a 'data' axis) each
+    step's batch is sharded across devices — params stay replicated and
+    XLA inserts the gradient psum (pure data parallelism over ICI).
+    Batch sizes that don't divide the device count (the reference's
+    exact-divisor sizes, 3020/3009) are padded with zero-weight
+    positions, which the weighted-mean loss ignores exactly."""
+
+    def __init__(self, model, config: TrainConfig, event_log=None, mesh=None):
         self.model = model
         self.config = config
         self.optimizer = optax.adam(config.learning_rate)
         self.sgd = optax.sgd(config.learning_rate * 10.0)
         self.event_log = event_log  # utils.logging.EventLog or None
+        self.mesh = mesh
         self._epoch_fns = {}  # (n_rows, n_batches) -> compiled epoch
         self._full_fns = {}
 
@@ -61,7 +69,22 @@ class Trainer:
 
     # -- compiled kernels --------------------------------------------------
     def _make_epoch_fn(self, n_rows: int, n_batches: int, batch: int):
-        model, opt = self.model, self.optimizer
+        model, opt, mesh = self.model, self.optimizer, self.mesh
+        if mesh is None:
+            batch_p = batch
+            pos_w = None
+        else:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            ndev = mesh.shape["data"]
+            batch_p = -(-batch // ndev) * ndev
+            # static per-position weight: padded tail positions are
+            # zero-weight (the masked-mean loss then ignores them)
+            pos_w = jnp.concatenate(
+                [jnp.ones(batch, jnp.float32),
+                 jnp.zeros(batch_p - batch, jnp.float32)]
+            )
+            batch_spec = NamedSharding(mesh, P(None, "data"))
 
         def epoch(params, opt_state, x, y, w, key, start, limit):
             """One epoch: scan over a fresh device-side permutation.
@@ -75,10 +98,20 @@ class Trainer:
             """
             perm = jax.random.permutation(key, n_rows)[: n_batches * batch]
             sched = perm.reshape(n_batches, batch)
+            if batch_p != batch:
+                # pad with index 0; the position weight zeroes it out
+                sched = jnp.pad(sched, ((0, 0), (0, batch_p - batch)))
+            if mesh is not None:
+                # shard each step's batch axis: the gather, forward and
+                # per-example grads split over devices; the loss/grad
+                # mean becomes an XLA-inserted psum over 'data'
+                sched = jax.lax.with_sharding_constraint(sched, batch_spec)
 
             def step(carry, idx):
                 params, opt_state, t = carry
                 bx, by, bw = x[idx], y[idx], w[idx]
+                if pos_w is not None:
+                    bw = bw * pos_w
                 loss, g = jax.value_and_grad(model.loss)(params, bx, by, bw)
                 updates, new_opt = opt.update(g, opt_state, params)
                 new_params = optax.apply_updates(params, updates)
@@ -139,6 +172,17 @@ class Trainer:
         x = jnp.asarray(x)
         y = jnp.asarray(y)
         w = jnp.ones((n,), jnp.float32) if weights is None else jnp.asarray(weights)
+        if self.mesh is not None:
+            from fia_tpu.parallel.distributed import put_global
+            from jax.sharding import PartitionSpec as P
+
+            x, y, w = (put_global(self.mesh, a, P()) for a in (x, y, w))
+            params0 = jax.tree_util.tree_map(jnp.asarray, state.params)
+            state = TrainState(
+                put_global(self.mesh, params0, P()),
+                put_global(self.mesh, state.opt_state, P()),
+                state.step,
+            )
 
         switch_b = cfg.iter_to_switch_to_batch
         switch_b = num_steps if switch_b is None else switch_b
@@ -203,20 +247,24 @@ class Trainer:
         return self.fit(state, x, y, weights=weights, num_steps=num_steps)
 
 
-_LOO_ADV_CACHE = {}
-
-
-def _loo_advance_fn(model, n, nb, batch_size, num_steps, learning_rate):
+def _loo_advance_fn(model, n, nb, batch_size, num_steps, learning_rate,
+                    mesh=None):
     """Compiled vmapped lane-advance, cached across calls.
 
     ``loo_retrain_many`` is invoked once per lane chunk (eval/rq1.py) —
     defining + jitting the closure inside it would recompile an
     identical-shape program for every chunk of every test point.
     Keyed by everything the closure captures; x/y are call arguments.
+    The cache lives ON the model instance: the compiled closure
+    references the model, so a global (even weak-keyed) registry would
+    pin every model+executable forever; as an instance attribute the
+    model→cache→closure→model loop is an ordinary collectable cycle and
+    sweeps constructing many models release each one's executables.
     """
-    key = (model, n, nb, batch_size, num_steps, learning_rate)
-    if key in _LOO_ADV_CACHE:
-        return _LOO_ADV_CACHE[key]
+    per_model = model.__dict__.setdefault("_loo_adv_cache", {})
+    key = (n, nb, batch_size, num_steps, learning_rate, mesh)
+    if key in per_model:
+        return per_model[key]
     opt = optax.adam(learning_rate)
 
     def advance(params, opt_state, t, ridx, keys_seg, x, y):
@@ -258,13 +306,31 @@ def _loo_advance_fn(model, n, nb, batch_size, num_steps, learning_rate):
         )
         return params, opt_state, t
 
+    vmapped = jax.vmap(advance, in_axes=(0, 0, 0, 0, 0, None, None))
+    if mesh is not None:
+        # lanes are embarrassingly parallel: shard the lane axis over the
+        # mesh 'data' axis (no collectives at all), x/y replicated
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        lane = NamedSharding(mesh, P("data"))
+        rep = NamedSharding(mesh, P())
+
+        def constrained(params, opt_state, t, ridx, keys_seg, x, y):
+            c = lambda tree, s: jax.tree_util.tree_map(
+                lambda l: jax.lax.with_sharding_constraint(l, s), tree
+            )
+            return vmapped(
+                c(params, lane), c(opt_state, lane), c(t, lane),
+                c(ridx, lane), c(keys_seg, lane), c(x, rep), c(y, rep),
+            )
+
+        body = constrained
+    else:
+        body = vmapped
     # donate the lane stacks: each segment's params/opt buffers alias the
     # previous one's instead of doubling peak HBM at every boundary
-    adv = jax.jit(
-        jax.vmap(advance, in_axes=(0, 0, 0, 0, 0, None, None)),
-        donate_argnums=(0, 1, 2),
-    )
-    _LOO_ADV_CACHE[key] = adv
+    adv = jax.jit(body, donate_argnums=(0, 1, 2))
+    per_model[key] = adv
     return adv
 
 
@@ -279,6 +345,7 @@ def loo_retrain_many(
     learning_rate: float = 1e-3,
     seeds=None,
     steps_per_dispatch: int = 2000,
+    mesh=None,
 ):
     """Leave-one-out retraining, vmapped over removed points.
 
@@ -291,6 +358,12 @@ def loo_retrain_many(
     ``seeds`` (R,) varies the batch shuffle per lane; lanes with equal
     seeds share a schedule. Returns the (R,) pytree-stack of retrained
     params.
+
+    With ``mesh`` (a Mesh with a 'data' axis) the lane axis is sharded
+    across devices — retraining is embarrassingly parallel, so an 8-chip
+    mesh runs 8 lanes for the price of one with zero collectives. Lane
+    counts are padded to a device multiple with no-op (-1) lanes; results
+    are identical to the single-device path lane for lane.
     """
     x = jnp.asarray(x)
     y = jnp.asarray(y)
@@ -304,6 +377,17 @@ def loo_retrain_many(
         seeds = jnp.full(removed.shape, 17, jnp.uint32)
     else:
         seeds = jnp.asarray(seeds, jnp.uint32)
+    R_real = removed.shape[0]
+    if mesh is not None:
+        ndev = mesh.shape["data"]
+        pad = (-R_real) % ndev
+        if pad:
+            removed = jnp.concatenate(
+                [removed, jnp.full((pad,), -1, jnp.int32)]
+            )
+            seeds = jnp.concatenate(
+                [seeds, jnp.full((pad,), 17, jnp.uint32)]
+            )
 
     n_epochs = -(-num_steps // nb)
     # Long vmapped training programs must be split across dispatches:
@@ -319,7 +403,8 @@ def loo_retrain_many(
         lambda s: jax.random.split(jax.random.PRNGKey(s), n_epochs)
     )(seeds)  # (R, n_epochs, 2)
 
-    adv = _loo_advance_fn(model, n, nb, batch_size, num_steps, learning_rate)
+    adv = _loo_advance_fn(model, n, nb, batch_size, num_steps, learning_rate,
+                          mesh=mesh)
     R = removed.shape[0]
     params = jax.tree_util.tree_map(
         lambda l: jnp.broadcast_to(l, (R, *l.shape)), params0
@@ -328,10 +413,25 @@ def loo_retrain_many(
         lambda l: jnp.broadcast_to(l, (R, *jnp.shape(l))), opt.init(params0)
     )
     t = jnp.zeros((R,), jnp.int32)
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        lane = NamedSharding(mesh, P("data"))
+        rep = NamedSharding(mesh, P())
+        place = lambda tree, s: jax.tree_util.tree_map(
+            lambda l: jax.device_put(l, s), tree
+        )
+        params, opt_state = place(params, lane), place(opt_state, lane)
+        t, removed, keys = (place(a, lane) for a in (t, removed, keys))
+        x, y = place(x, rep), place(y, rep)
     # the ragged tail scans only the remaining epochs (one extra compile)
     # rather than a padded segment of masked no-op steps
     for start in range(0, n_epochs, seg_epochs):
         seg = keys[:, start : start + seg_epochs]
         params, opt_state, t = adv(params, opt_state, t, removed, seg, x, y)
         jax.block_until_ready(t)
-    return params
+    return (
+        params
+        if R == R_real
+        else jax.tree_util.tree_map(lambda l: l[:R_real], params)
+    )

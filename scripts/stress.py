@@ -73,6 +73,9 @@ def main() -> None:
     from fia_tpu.influence.engine import InfluenceEngine
     from fia_tpu.models import MF
     from fia_tpu.train.trainer import Trainer, TrainConfig
+    from fia_tpu.utils.logging import EventLog
+
+    log = EventLog(os.path.join("output", "events-stress.jsonl"))
 
     if args.smoke:
         users, items, rows = 600, 300, 30_000
@@ -119,7 +122,7 @@ def main() -> None:
         train_y = dist.put_global(mesh, train_y, P())
 
     tr = Trainer(model, TrainConfig(batch_size=batch, num_steps=steps,
-                                    learning_rate=1e-2))
+                                    learning_rate=1e-2), event_log=log)
     t0 = time.perf_counter()
     state = tr.fit(tr.init_state(params), train_x, train_y)
     train_s = time.perf_counter() - t0
@@ -152,6 +155,9 @@ def main() -> None:
             "num_scores": timing.num_scores,
         },
     }
+    log.log("query_batch", **timing.json())
+    log.log("run_done", value=out["value"])
+    log.close()
     print(json.dumps(out))
 
 

@@ -454,3 +454,25 @@ class TestEngine:
         cached[0].write_bytes(b"not a zip")
         healed = eng2.get_influence_on_test_loss([0], test_ds, force_refresh=False)
         np.testing.assert_allclose(healed, fresh)
+
+    def test_cache_guards_against_different_train_set(self, model_cls, tmp_path):
+        """Identical params over a leave-one-out train subset must not be
+        served the full set's cached scores (ADVICE r1): the train
+        checksums are exact, so even a one-row difference — far below
+        any relative tolerance at real scale — invalidates the hit."""
+        model, params, train = _setup(model_cls)
+        loo = RatingDataset(train.x[1:], train.y[1:])
+        eng = InfluenceEngine(model, params, train, damping=DAMP,
+                              cache_dir=str(tmp_path), model_name="m")
+        eng_loo = InfluenceEngine(model, params, loo, damping=DAMP,
+                                  cache_dir=str(tmp_path), model_name="m")
+        assert not eng_loo._fingerprint_matches(eng._params_fingerprint())
+        assert eng_loo._fingerprint_matches(eng_loo._params_fingerprint())
+        test_ds = RatingDataset(np.array([[3, 5]], np.int32), np.array([4.0]))
+        full_scores = eng.get_influence_on_test_loss([0], test_ds)
+        # row 0 of the full set is (u=3, i=?) or not — either way the
+        # related sets can differ; the guard must force a recompute
+        loo_scores = eng_loo.get_influence_on_test_loss(
+            [0], test_ds, force_refresh=False
+        )
+        assert loo_scores.shape == (eng_loo.index.related_count(3, 5),)
