@@ -30,7 +30,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from fia_tpu.data.dataset import RatingDataset
 from fia_tpu.data.index import InteractionIndex, bucketed_pad
@@ -319,13 +319,28 @@ class InfluenceEngine:
         if key in self._jitted:
             return self._jitted[key]
         model = self.model
+        mesh = self.mesh
         d = model.block_size
         # chunk must divide S; flat_chunk is a power of two and S a
         # multiple of the bucket floor, so the gcd is their largest
         # common chunking (≥ 2048 whenever flat_chunk ≥ 2048)
         import math
 
-        chunk = math.gcd(s_pad, self.flat_chunk)
+        if mesh is None:
+            chunk = math.gcd(s_pad, self.flat_chunk)
+        else:
+            # _query_flat rounded S up to a device multiple; the chunk
+            # must divide the PER-DEVICE shard, not just S
+            ndev = mesh.shape["data"]
+            assert s_pad % ndev == 0, (s_pad, ndev)
+            chunk = math.gcd(s_pad // ndev, self.flat_chunk)
+
+            def c(a):  # shard an S-leading array across 'data'
+                return jax.lax.with_sharding_constraint(
+                    a, NamedSharding(
+                        mesh, P("data", *([None] * (a.ndim - 1)))
+                    )
+                )
 
         def fn(params, train_x, train_y, postings, tx):
             T = tx.shape[0]
@@ -349,6 +364,11 @@ class InfluenceEngine:
                 urows[jnp.clip(uoff[ut] + pos, 0, urows.shape[0] - 1)],
                 irows[jnp.clip(ioff[it] + pos - nu[t], 0, irows.shape[0] - 1)],
             )
+            if mesh is not None:
+                # shard the flat row axis: the gather, gradient vmap and
+                # Hessian accumulation below all split across devices
+                row, t, pos, valid = (c(a) for a in (row, t, pos, valid))
+                ut, it = c(u[t]), c(i[t])
             rel_x = train_x[row]
             rel_y = train_y[row]
             wv = valid.astype(jnp.float32)
@@ -371,21 +391,42 @@ class InfluenceEngine:
 
             # H_t = (2/n_t) Σ_{s∈t} w (g gᵀ + a b e C) + diag(reg) + λI,
             # accumulated in chunks so the outer-product buffer stays small
-            nc = s_pad // chunk
-            g_r = g.reshape(nc, chunk, d)
-            t_r = t.reshape(nc, chunk)
-            w_r = wv.reshape(nc, chunk)
-
-            def body(acc, args):
-                gc, tc, wc = args
-                outer = (gc * wc[:, None])[:, :, None] * gc[:, None, :]
-                return acc.at[tc].add(outer), None
-
-            HH = jax.lax.scan(
-                body, jnp.zeros((T, d, d), jnp.float32), (g_r, t_r, w_r)
-            )[0]
             ab = wv * (rel_x[:, 0] == ut) * (rel_x[:, 1] == it)
-            sum_abe = jnp.zeros((T,), jnp.float32).at[t].add(ab * e)
+
+            def accum(g_r, t_r, w_r, abe_r):
+                """Chunked scan: (nc, chunk, ...) -> (T, d, d), (T,)."""
+
+                def body(carry, args):
+                    acc, s_abe = carry
+                    gc, tc, wc, ac = args
+                    outer = (gc * wc[:, None])[:, :, None] * gc[:, None, :]
+                    return (acc.at[tc].add(outer), s_abe.at[tc].add(ac)), None
+
+                (acc, s_abe), _ = jax.lax.scan(
+                    body,
+                    (jnp.zeros((T, d, d), jnp.float32),
+                     jnp.zeros((T,), jnp.float32)),
+                    (g_r, t_r, w_r, abe_r),
+                )
+                return acc, s_abe
+
+            nc = s_pad // chunk
+            if mesh is None:
+                HH, sum_abe = accum(
+                    g.reshape(nc, chunk, d), t.reshape(nc, chunk),
+                    wv.reshape(nc, chunk), (ab * e).reshape(nc, chunk),
+                )
+            else:
+                # per-device partial accumulators (the device axis is the
+                # sharded leading dim, so the vmap is purely local work),
+                # then a sum over it — the one XLA-inserted psum
+                nl = nc // ndev
+                shp = lambda a, *tail: c(a.reshape(ndev, nl, chunk, *tail))
+                HH_p, abe_p = jax.vmap(accum)(
+                    shp(g, d), shp(t), shp(wv), shp(ab * e)
+                )
+                HH = jnp.sum(HH_p, axis=0)
+                sum_abe = jnp.sum(abe_p, axis=0)
             n_t = jnp.maximum(counts.astype(jnp.float32), 1.0)
             C = model.block_cross_const(params)
             rdiag = model.block_reg_diag(params)
@@ -418,7 +459,10 @@ class InfluenceEngine:
 
     def _flat_eligible(self) -> bool:
         return (
-            self.mesh is None
+            # single-process meshes shard the flat axis (per-device
+            # partial Hessians + psum); multi-host output assembly would
+            # need a process allgather — padded path covers that regime
+            not self._multihost
             and self.solver == "direct"
             and not self.use_pallas
             and not self.group_queries
@@ -444,6 +488,12 @@ class InfluenceEngine:
         # multiple of every flat_chunk ≤ floor (the scan reshape needs
         # chunk | S).
         s_pad = bucketed_pad(total, 2048)
+        if self.mesh is not None:
+            # the flat axis splits into ndev chunk-aligned shards
+            import math
+
+            gran = math.gcd(s_pad, self.flat_chunk) * self.mesh.shape["data"]
+            s_pad = -(-s_pad // gran) * gran
         tx = jnp.asarray(test_points, jnp.int32)
         out = self._flat_fn(s_pad)(
             self.params, self.train_x, self.train_y, self._postings, tx
@@ -529,8 +579,9 @@ class InfluenceEngine:
             return self._query_flat(test_points, pad_to)
         if self.impl == "flat":
             raise ValueError(
-                "impl='flat' requires a single-device engine with the "
-                "direct solver and a model defining the Gauss-Newton hooks"
+                "impl='flat' requires the direct solver, a model defining "
+                "the Gauss-Newton hooks, and a single-process (possibly "
+                "multi-device) engine"
             )
 
         if self.group_queries and pad_to is None and T > 1:

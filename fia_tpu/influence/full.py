@@ -43,6 +43,7 @@ class FullInfluenceEngine:
         lissa_scale: float = 10.0,
         lissa_depth: int = 10_000,  # reference depth, genericNeuralNet.py:544
         lissa_batch: int = 0,  # 0 = full-batch HVPs inside LiSSA
+        hvp_batch: int = 0,  # 0 = one full-batch HVP program; >0 = scan
         mesh: Mesh | None = None,
     ):
         self.model = model
@@ -80,13 +81,63 @@ class FullInfluenceEngine:
         self.num_params = flat.shape[0]
         self.num_train = int(self.train_x.shape[0])
 
+        # Chunked HVP: one full-batch double-backprop program over
+        # ML-20M-scale train sets peaks at O(N) residual activations; a
+        # lax.scan over row chunks bounds the live set to one chunk.
+        # Chunks are gathered in-program from the resident train tensors
+        # (no second copy of the train set); the ragged tail re-reads
+        # row 0 at weight 0, which the summed chunk loss ignores exactly.
+        self.hvp_batch = int(hvp_batch)
+        if self.hvp_batch > 0:
+            # a chunk larger than the train set would only add dead rows
+            b = max(1, min(self.hvp_batch, self.num_train))
+            if mesh is not None:
+                # each chunk's row axis is sharded across 'data'
+                b = -(-b // mesh.shape["data"]) * mesh.shape["data"]
+            self.hvp_batch = b
+
     # -- core pieces -------------------------------------------------------
     def _total_loss_flat(self, fvec):
         return self.model.loss(self._unravel(fvec), self.train_x, self.train_y)
 
     def _hvp(self, v):
-        hv = jax.jvp(jax.grad(self._total_loss_flat), (self._flat0,), (v,))[1]
-        return hv + self.damping * v
+        n = self.num_train
+        if self.hvp_batch <= 0 or self.hvp_batch >= n:
+            hv = jax.jvp(jax.grad(self._total_loss_flat), (self._flat0,), (v,))[1]
+            return hv + self.damping * v
+        b = self.hvp_batch
+        nb = -(-n // b)
+        iota = jnp.arange(b, dtype=jnp.int32)
+        mesh = self.mesh
+
+        def chunk_hvp(acc, ci):
+            gidx = ci * b + iota
+            w = (gidx < n).astype(jnp.float32)
+            idx = jnp.where(gidx < n, gidx, 0)
+            x, y = self.train_x[idx], self.train_y[idx]
+            if mesh is not None:
+                c = lambda a: jax.lax.with_sharding_constraint(
+                    a, NamedSharding(
+                        mesh, P("data", *([None] * (a.ndim - 1)))
+                    )
+                )
+                x, y, w = c(x), c(y), c(w)
+
+            def loss_sum(fvec):
+                p = self._unravel(fvec)
+                return jnp.sum(self.model.indiv_loss(p, x, y) * w)
+
+            hv = jax.jvp(jax.grad(loss_sum), (self._flat0,), (v,))[1]
+            return acc + hv, None
+
+        err_hv = jax.lax.scan(
+            chunk_hvp, jnp.zeros_like(v), jnp.arange(nb, dtype=jnp.int32)
+        )[0] / n
+        reg_hv = jax.jvp(
+            jax.grad(lambda f: self.model.reg_loss(self._unravel(f))),
+            (self._flat0,), (v,),
+        )[1]
+        return err_hv + reg_hv + self.damping * v
 
     def _lissa_sample_hvp(self, key):
         n = self.num_train

@@ -51,6 +51,12 @@ def main() -> None:
                     help=">1 row-shards the embedding tables over a "
                          "'model' mesh axis (needs that many devices)")
     ap.add_argument("--batch_size", type=int, default=8192)
+    ap.add_argument("--full_space", action="store_true",
+                    help="also probe the FULL-parameter influence engine "
+                         "(chunked-HVP CG over every train row) at this "
+                         "scale — the non-block Koh&Liang path")
+    ap.add_argument("--hvp_batch", type=int, default=1 << 20,
+                    help="rows per chunk of the full-space HVP scan")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--coordinator", type=str, default=None,
                     help="coordinator address for multi-host runs "
@@ -155,6 +161,36 @@ def main() -> None:
             "num_scores": timing.num_scores,
         },
     }
+    if args.full_space:
+        import numpy as np
+
+        from fia_tpu.influence.full import FullInfluenceEngine
+
+        # FullInfluenceEngine places tensors with plain device_put — fine
+        # for local (possibly multi-device) meshes, unsupported across
+        # processes; fall back to this process's devices there.
+        fs_mesh = None if (mesh is not None and dist.spans_processes(mesh)) else mesh
+        fe = FullInfluenceEngine(
+            model, state.params, train, damping=1e-4, solver="cg",
+            cg_maxiter=10, hvp_batch=args.hvp_batch, mesh=fs_mesh,
+        )
+        print(f"stress: full-space probe ({fe.num_params} params, "
+              f"{fe.num_train} rows, hvp_batch={fe.hvp_batch})",
+              file=sys.stderr, flush=True)
+        t0 = time.perf_counter()
+        fs_scores = fe.get_influence_on_test_prediction(points[:1])
+        fs_s = time.perf_counter() - t0
+        out["details"]["full_space"] = {
+            "num_params": fe.num_params,
+            "cg_maxiter": 10,
+            "hvp_batch": fe.hvp_batch,
+            # first call compiles the CG-over-scan program; one probe run
+            # only, so report the honest end-to-end figure
+            "e2e_incl_compile_s": round(fs_s, 2),
+            "finite": bool(np.isfinite(fs_scores).all()),
+        }
+        print(f"stress: full-space query in {fs_s:.1f}s (incl. compile)",
+              file=sys.stderr, flush=True)
     log.log("query_batch", **timing.json())
     log.log("run_done", value=out["value"])
     log.close()

@@ -111,3 +111,25 @@ class TestFullEngine:
         out = eng.get_influence_on_test_prediction(train.x[:1])
         assert out.shape == (train.num_examples,)
         assert np.isfinite(out).all()
+
+    def test_chunked_hvp_matches_full_batch(self):
+        """hvp_batch > 0 scans (ML-20M-capable path); must equal the
+        one-program full-batch HVP, including the ragged padded tail
+        (150 rows, chunks of 64)."""
+        model, params, train = _setup()
+        damp = _pd_damping(model, params, train)
+        tx, ty = train.x[:2], train.y[:2]
+        full = FullInfluenceEngine(model, params, train, damping=damp,
+                                   solver="cg", cg_tol=1e-12, cg_maxiter=300)
+        chunked = FullInfluenceEngine(model, params, train, damping=damp,
+                                      solver="cg", cg_tol=1e-12,
+                                      cg_maxiter=300, hvp_batch=64)
+        v = np.asarray(full.test_loss_grad(tx, ty))
+        np.testing.assert_allclose(
+            np.asarray(chunked._hvp(jnp.asarray(v))),
+            np.asarray(full._hvp(jnp.asarray(v))), rtol=1e-4, atol=1e-6,
+        )
+        np.testing.assert_allclose(
+            chunked.get_influence_on_test_loss(tx, ty),
+            full.get_influence_on_test_loss(tx, ty), rtol=1e-3, atol=1e-6,
+        )

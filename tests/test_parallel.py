@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from fia_tpu.data.dataset import RatingDataset
 from fia_tpu.influence.engine import InfluenceEngine
@@ -57,16 +58,45 @@ class TestShardedInfluence:
         """T not divisible by mesh size still returns T results."""
         model, params, train = _setup()
         mesh = make_mesh(8)
-        eng = InfluenceEngine(model, params, train, damping=1e-3, mesh=mesh)
+        eng = InfluenceEngine(model, params, train, damping=1e-3, mesh=mesh,
+                              impl="padded")
         pts = np.array([[3, 5], [0, 1], [7, 2]])  # 3 % 8 != 0
         res = eng.query_batch(pts)
         assert res.scores.shape[0] == 3
 
+    def test_flat_on_mesh_matches_padded(self):
+        """The flat segment-sum path sharded over the mesh (per-device
+        partial Hessians + psum) must equal the padded mesh path and the
+        single-device flat path."""
+        model, params, train = _setup()
+        pts = np.array([[3, 5], [0, 1], [7, 2], [11, 9], [1, 1]])
+        mesh = make_mesh(8)
+        flat = InfluenceEngine(model, params, train, damping=1e-3,
+                               mesh=mesh, impl="flat")
+        padded = InfluenceEngine(model, params, train, damping=1e-3,
+                                 mesh=mesh, impl="padded")
+        single = InfluenceEngine(model, params, train, damping=1e-3,
+                                 impl="flat")
+        a = flat.query_batch(pts)
+        b = padded.query_batch(pts)
+        c = single.query_batch(pts)
+        assert np.array_equal(a.counts, b.counts)
+        for t in range(len(pts)):
+            np.testing.assert_allclose(a.scores_of(t), b.scores_of(t),
+                                       rtol=1e-4, atol=1e-6)
+            np.testing.assert_allclose(a.scores_of(t), c.scores_of(t),
+                                       rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(a.ihvp, b.ihvp, rtol=1e-4, atol=1e-6)
+
 
 class TestShardedTables:
-    def test_table_sharded_query_matches(self):
+    @pytest.mark.parametrize("impl", ["flat", "padded"])
+    def test_table_sharded_query_matches(self, impl):
         """2-D ('data','model') mesh with row-sharded embedding tables
-        must reproduce the single-device scores (stress config)."""
+        must reproduce the single-device scores (stress config) on BOTH
+        query impls — 'padded' is the only one available multi-host, so
+        it must keep single-process coverage even though 'auto' now
+        prefers 'flat'."""
         from fia_tpu.parallel.sharded import make_2d_mesh
 
         model, params, train = _setup()
@@ -75,7 +105,7 @@ class TestShardedTables:
         want = base.query_batch(pts)
         mesh = make_2d_mesh(8, model_parallel=2)
         eng = InfluenceEngine(model, params, train, damping=1e-3,
-                              mesh=mesh, shard_tables=True)
+                              mesh=mesh, shard_tables=True, impl=impl)
         got = eng.query_batch(pts, pad_to=want.scores.shape[1])
         for t in range(len(pts)):
             np.testing.assert_allclose(
@@ -165,6 +195,21 @@ class TestShardedFullHVP:
         mesh = make_mesh(8)
         shrd = FullInfluenceEngine(model, params, train, damping=1e-2,
                                    solver="cg", mesh=mesh)
+        tx, ty = train.x[:3], train.y[:3]
+        a = base.get_influence_on_test_loss(tx, ty)
+        b = shrd.get_influence_on_test_loss(tx, ty)
+        np.testing.assert_allclose(a, b, rtol=1e-3, atol=1e-6)
+
+    def test_full_engine_sharded_chunked_hvp_matches(self):
+        """Chunked HVP scan with each chunk's row axis sharded over the
+        mesh must equal the single-device full-batch path."""
+        model, params, train = _setup(n=400)
+        base = FullInfluenceEngine(model, params, train, damping=1e-2,
+                                   solver="cg")
+        mesh = make_mesh(8)
+        shrd = FullInfluenceEngine(model, params, train, damping=1e-2,
+                                   solver="cg", mesh=mesh, hvp_batch=100)
+        assert shrd.hvp_batch % 8 == 0  # rounded to a device multiple
         tx, ty = train.x[:3], train.y[:3]
         a = base.get_influence_on_test_loss(tx, ty)
         b = shrd.get_influence_on_test_loss(tx, ty)
