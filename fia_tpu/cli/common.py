@@ -74,8 +74,6 @@ def base_parser(desc: str) -> argparse.ArgumentParser:
                    choices=["auto", "flat", "padded"],
                    help="query implementation: flat segment-sum or "
                         "padded per-query vmap")
-    p.add_argument("--use_pallas", type=int, default=0,
-                   help="1: fused Pallas scoring kernel (MF only)")
     p.add_argument("--mesh", type=int, default=0,
                    help="shard query batches, training and LOO retraining "
                         "over an N-device 'data' mesh (0 = single device)")
@@ -91,6 +89,10 @@ def base_parser(desc: str) -> argparse.ArgumentParser:
     p.add_argument("--batch_size", type=int, default=0,
                    help="0 = reference default for the dataset")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--calibrate", type=int, default=1,
+                   help="1: synthesize missing train splits calibrated to "
+                        "the real valid/test marginals; 0: generic Zipf "
+                        "generator (the round-1 measurement stream)")
     # synthetic scale (used when --dataset synthetic)
     p.add_argument("--synth_users", type=int, default=600)
     p.add_argument("--synth_items", type=int, default=400)
@@ -110,7 +112,6 @@ def engine_kwargs(args) -> dict:
         lissa_depth=args.lissa_depth,
         lissa_scale=args.lissa_scale,
         impl=args.impl,
-        use_pallas=bool(args.use_pallas),
     )
 
 
@@ -175,8 +176,13 @@ def load_splits(args):
             args.synth_users, args.synth_items, args.synth_train,
             args.synth_test, seed=args.seed,
         )
-    return load_dataset(args.dataset, args.data_dir, synthesize_train=True,
-                        synth_seed=args.seed)
+    splits = load_dataset(args.dataset, args.data_dir, synthesize_train=True,
+                          synth_seed=args.seed,
+                          calibrate=bool(getattr(args, "calibrate", 1)))
+    # generator tag flows into checkpoint/model names (model_name_for):
+    # a calibrated-split run must never load a Zipf-split checkpoint
+    args._synth_tag = getattr(splits["train"], "synth_tag", "")
+    return splits
 
 
 def batch_size_for(args, train) -> int:
@@ -189,10 +195,12 @@ def batch_size_for(args, train) -> int:
 
 def model_name_for(args, wd=None) -> str:
     wd = args.weight_decay if wd is None else wd
+    tag = getattr(args, "_synth_tag", "")
     return (
         f"{args.dataset}_{args.model}_explicit_damping{args.damping:.0e}"
         f"_avextol{args.avextol:.0e}_embed{args.embed_size}"
         f"_maxinf{args.maxinf}_wd{wd:.0e}"
+        + (f"_{tag}" if tag else "")
     )
 
 

@@ -19,7 +19,7 @@ import numpy as np
 
 from fia_tpu.data import native
 from fia_tpu.data.dataset import RatingDataset
-from fia_tpu.data.synthetic import synthesize_ratings
+from fia_tpu.data.synthetic import synthesize_calibrated, synthesize_ratings
 
 # Reference slice counts (load_movielens.py:12-17, load_yelp.py:12-16).
 _SPECS = {
@@ -49,8 +49,16 @@ def load_dataset(
     data_dir: str,
     synthesize_train: bool = True,
     synth_seed: int = 0,
+    calibrate: bool = True,
 ) -> dict[str, RatingDataset]:
-    """Load {train, validation, test} RatingDatasets for a named dataset."""
+    """Load {train, validation, test} RatingDatasets for a named dataset.
+
+    A missing train file (stripped upstream) is synthesized; by default
+    the generator is CALIBRATED to the real valid/test files (empirical
+    item marginals, constrained lognormal user degrees, heldout-pair
+    disjointness — ``synthesize_calibrated``). ``calibrate=False`` keeps
+    the generic Zipf(0.8) generator the round-1 measurements used.
+    """
     if name not in _SPECS:
         raise ValueError(f"unknown dataset {name!r}; have {sorted(_SPECS)}")
     spec = _SPECS[name]
@@ -66,10 +74,19 @@ def load_dataset(
         train = _read_tsv(paths["train"], spec["n_train"])
     elif synthesize_train:
         cover = np.concatenate([valid.x, test.x], axis=0)
-        train = synthesize_ratings(
-            spec["num_users"], spec["num_items"], spec["n_train"],
-            seed=synth_seed, ensure_cover=cover,
-        )
+        if calibrate:
+            train = synthesize_calibrated(
+                spec["num_users"], spec["num_items"], spec["n_train"],
+                heldout_x=cover, seed=synth_seed,
+            )
+            # checkpoint/model names key on this tag so calibrated-split
+            # checkpoints never collide with the older Zipf-split ones
+            train.synth_tag = "cal1"
+        else:
+            train = synthesize_ratings(
+                spec["num_users"], spec["num_items"], spec["n_train"],
+                seed=synth_seed, ensure_cover=cover,
+            )
     else:
         raise FileNotFoundError(
             f"{paths['train']} missing (stripped from the reference repo); "

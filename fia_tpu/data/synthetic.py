@@ -55,7 +55,18 @@ def synthesize_ratings(
         users[len(cu) : need] = rng.integers(0, num_users, size=len(ci))
         items[len(cu) : need] = ci
 
-    # Planted MF structure: r = clip(round(mu + b_u + b_i + p_u.q_i + eps), 1, 5)
+    ratings = _planted_ratings(users, items, num_users, num_items, rng,
+                               rank=rank, noise=noise)
+
+    x = np.stack([users, items], axis=1).astype(np.int32)
+    return RatingDataset(x, ratings)
+
+
+def _planted_ratings(users, items, num_users, num_items, rng,
+                     rank: int = 8, noise: float = 0.4) -> np.ndarray:
+    """Ratings from a planted MF model (r = clip(round(mu + b_u + b_i +
+    p_u.q_i + eps), 1, 5)) — the 1-5 star scale of the real files."""
+    num_rows = len(users)
     p = rng.normal(0, 1.0 / np.sqrt(rank), size=(num_users, rank))
     q = rng.normal(0, 1.0 / np.sqrt(rank), size=(num_items, rank))
     bu = rng.normal(0, 0.3, size=num_users)
@@ -67,10 +78,126 @@ def synthesize_ratings(
         + np.einsum("nk,nk->n", p[users], q[items])
         + rng.normal(0, noise, size=num_rows)
     )
-    ratings = np.clip(np.rint(scores), 1.0, 5.0).astype(np.float32)
+    return np.clip(np.rint(scores), 1.0, 5.0).astype(np.float32)
 
-    x = np.stack([users, items], axis=1).astype(np.int32)
-    return RatingDataset(x, ratings)
+
+def fit_user_degree_profile(
+    num_users: int,
+    num_rows: int,
+    min_degree: int,
+    rng,
+    sigma: float = 1.0,
+) -> np.ndarray:
+    """Per-user train degrees under the reference's leave-4-out protocol.
+
+    The reference valid/test files hold EXACTLY 4 rows per user
+    (measured: ml-1m-ex and yelp-ex both have every user at degree 4), so
+    user marginals are NOT identifiable from the splits — only two facts
+    are pinned down: every user has at least ``min_degree`` train rows
+    (the source data's min-ratings filter minus the 4 held out) and the
+    mean degree is num_rows/num_users. Within those constraints the
+    profile is shifted-lognormal quantiles (σ=1 reproduces the
+    magnitude/median/max shape of public MovieLens-1M user degrees),
+    scaled exactly to num_rows by largest-remainder rounding and randomly
+    permuted over user ids so popularity is decoupled from id order.
+    """
+    mean = num_rows / num_users
+    if mean <= min_degree:
+        raise ValueError(
+            f"num_rows/num_users = {mean:.1f} <= min_degree {min_degree}"
+        )
+    from scipy.special import ndtri  # Phi^-1; scipy ships in the image
+
+    mu = np.log(mean - min_degree) - 0.5 * sigma**2
+    q = (np.arange(num_users) + 0.5) / num_users
+    d = min_degree + np.exp(mu + sigma * ndtri(q))
+    # exact total: floor, then distribute the remainder by largest frac
+    d *= num_rows / d.sum()
+    d = np.maximum(d, min_degree)
+    d *= num_rows / d.sum()  # re-normalise after the floor clamp
+    base = np.floor(d).astype(np.int64)
+    short = num_rows - base.sum()
+    order = np.argsort(d - base)[::-1]
+    base[order[:short]] += 1
+    return base[rng.permutation(num_users)]
+
+
+def synthesize_calibrated(
+    num_users: int,
+    num_items: int,
+    num_rows: int,
+    heldout_x: np.ndarray,
+    seed: int = 0,
+    min_degree: int = 16,
+    rank: int = 8,
+    noise: float = 0.4,
+) -> RatingDataset:
+    """Train split calibrated to the reference's real valid/test files.
+
+    ``heldout_x`` is the concatenated (valid+test) (M, 2) pair array.
+    Item popularity is fit EMPIRICALLY from it (counts + 0.5 smoothing so
+    items unseen in the 4-per-user holdout keep mass); user degrees come
+    from :func:`fit_user_degree_profile`. Train pairs are kept disjoint
+    from the heldout pairs (as the reference's real splits are — they
+    were literally held out of train), and every heldout item is
+    guaranteed at least one train row so FIA queries have non-empty
+    related sets on both sides.
+    """
+    rng = np.random.default_rng(seed)
+    heldout_x = np.asarray(heldout_x)
+    ic = np.bincount(heldout_x[:, 1], minlength=num_items).astype(np.float64)
+    p_item = ic + 0.5
+    p_item /= p_item.sum()
+
+    degrees = fit_user_degree_profile(num_users, num_rows, min_degree, rng)
+    users = np.repeat(np.arange(num_users, dtype=np.int64), degrees)
+    items = rng.choice(num_items, size=num_rows, p=p_item)
+
+    # resample collisions with heldout pairs (the reference train never
+    # contains them); a handful of rounds clears the few-per-mille hits
+    held_codes = np.unique(
+        heldout_x[:, 0].astype(np.int64) * num_items + heldout_x[:, 1]
+    )
+    for _ in range(64):
+        codes = users * num_items + items
+        bad = np.isin(codes, held_codes)
+        if not bad.any():
+            break
+        items[bad] = rng.choice(num_items, size=int(bad.sum()), p=p_item)
+    else:
+        raise RuntimeError("could not decollide train pairs from heldout")
+
+    # cover heldout items that drew zero rows: overwrite the item of one
+    # random row each (user degrees untouched). A live per-item count
+    # guards the donor choice — stealing an item's SOLE row would
+    # un-cover it (sparse marginals like yelp's have many 1-row items)
+    live = np.bincount(items, minlength=num_items)
+    need = np.flatnonzero((ic > 0) & (live == 0))
+    if len(need):
+        cand = rng.permutation(num_rows)
+        ci = 0
+        for it in need:
+            while ci < num_rows:
+                r = cand[ci]
+                ci += 1
+                if live[items[r]] <= 1:
+                    continue  # sole remaining row of its item
+                code = users[r] * num_items + int(it)
+                j = np.searchsorted(held_codes, code)
+                if j == len(held_codes) or held_codes[j] != code:
+                    live[items[r]] -= 1
+                    items[r] = it
+                    live[it] += 1
+                    break
+            else:
+                raise RuntimeError("could not cover heldout items")
+
+    ratings = _planted_ratings(users, items, num_users, num_items,
+                               np.random.default_rng(seed + 1),
+                               rank=rank, noise=noise)
+    perm = rng.permutation(num_rows)
+    x = np.stack([users, items], axis=1).astype(np.int32)[perm]
+    return RatingDataset(x, ratings[perm])
 
 
 def sample_heldout_pairs(

@@ -93,7 +93,6 @@ class InfluenceEngine:
         cache_dir: str | None = None,
         model_name: str = "model",
         pad_bucket: int = 128,
-        use_pallas: bool = False,
         shard_tables: bool = False,
         hessian_mode: str = "auto",
         group_queries: bool = False,
@@ -153,10 +152,8 @@ class InfluenceEngine:
         self.cache_dir = cache_dir
         self.model_name = model_name
         self.pad_bucket = int(pad_bucket)
-        # Pallas fused-scoring fast path (MF only); interpret mode makes
-        # it runnable (and testable) on CPU.
-        self.use_pallas = bool(use_pallas)
-        self._pallas_interpret = jax.default_backend() != "tpu"
+        # (A Pallas fused-scoring kernel existed through r1; retired r2
+        # after a measured A/B loss to both XLA paths — BASELINE.md §4.)
         # Direct-solver Hessian build: 'analytic' uses the model's
         # closed-form block Hessian (when it defines one), 'autodiff'
         # materialises it by batched HVPs over the identity. Measured:
@@ -254,38 +251,10 @@ class InfluenceEngine:
 
         # One vmapped per-example-gradient batch + one matvec replaces the
         # reference's per-row sess.run scoring loop.
-        if self.use_pallas:
-            scores = self._pallas_scores(
-                params, u, i, rel_x, rel_y, rel_mask, ihvp, count
-            )
-        else:
-            per_ex = G.per_example_block_loss_grads(model, params, u, i, rel_x, rel_y)
-            scores = (per_ex @ ihvp) / jnp.maximum(count, 1.0)
-            scores = jnp.where(rel_mask, scores, 0.0)
+        per_ex = G.per_example_block_loss_grads(model, params, u, i, rel_x, rel_y)
+        scores = (per_ex @ ihvp) / jnp.maximum(count, 1.0)
+        scores = jnp.where(rel_mask, scores, 0.0)
         return scores, ihvp, v, rel_mask
-
-    def _pallas_scores(self, params, u, i, rel_x, rel_y, rel_mask, ihvp, count):
-        """Fused MF scoring kernel (ops/score_mf.py); closed-form per-row
-        gradients, no autodiff graph. MF only."""
-        from fia_tpu.models.mf import MF as _MF
-        from fia_tpu.ops.score_mf import mf_influence_scores
-
-        model = self.model
-        if not isinstance(model, _MF):
-            raise ValueError("use_pallas scoring is implemented for MF only")
-        k = model.embedding_size
-        cnt = jnp.maximum(count, 1.0)
-        pred = model.predict(params, rel_x)
-        e2 = 2.0 * (pred - rel_y) / cnt
-        mu = jnp.where(rel_mask, (rel_x[:, 0] == u).astype(jnp.float32), 0.0)
-        mi = jnp.where(rel_mask, (rel_x[:, 1] == i).astype(jnp.float32), 0.0)
-        const = model.weight_decay * (
-            jnp.dot(params["P"][u], ihvp[:k]) + jnp.dot(params["Q"][i], ihvp[k : 2 * k])
-        ) / cnt
-        return mf_influence_scores(
-            params["Q"][rel_x[:, 1]], params["P"][rel_x[:, 0]],
-            e2, mu, mi, ihvp, const, interpret=self._pallas_interpret,
-        )
 
     def _batched(self, pad: int):
         if pad not in self._jitted:
@@ -464,7 +433,6 @@ class InfluenceEngine:
             # need a process allgather — padded path covers that regime
             not self._multihost
             and self.solver == "direct"
-            and not self.use_pallas
             and not self.group_queries
             # the flat path always builds the Hessian from the analytic
             # GN hooks — an explicit 'autodiff' request must be honored

@@ -1,0 +1,190 @@
+#!/usr/bin/env python
+"""A/B the influence-query implementations on the chip.
+
+Variants: 'flat' (segment-sum, the auto default) and 'padded'
+(per-query vmap). A third variant — a Pallas fused-scoring kernel on
+the padded path — was measured here on 2026-07-30 (MF ML-1M calibrated
+stream, 256-query batches, interleaved minima): flat 1,579k scores/s,
+padded 1,134k, pallas 985k. The kernel lost to BOTH XLA paths and was
+deleted (BASELINE.md §4); XLA's fusion of the scoring matvec into the
+query program beats a hand kernel that only covers scoring.
+
+Rounds are INTERLEAVED and each variant's minimum is reported — the
+tunneled chip's run-to-run variance swamps sequential comparisons —
+and every round uses a different query batch so no identical-input
+caching can short-circuit dispatches.
+
+Also (--breakdown) splits one flat query batch into device-program time
+vs host assembly/transfer, and (--trace DIR) wraps a batch in a
+jax.profiler trace.
+
+Usage: python scripts/ab_impls.py [--quick] [--model NCF] [--rounds 5]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The axon (tunneled-TPU) image's sitecustomize re-selects its platform
+# via jax.config at interpreter start, OVERRIDING JAX_PLATFORMS — an
+# explicit CPU ask must be re-applied through jax.config too.
+if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--quick", action="store_true", help="small shapes")
+    ap.add_argument("--model", default="MF", choices=["MF", "NCF"])
+    ap.add_argument("--rounds", type=int, default=5)
+    ap.add_argument("--batch_queries", type=int, default=256)
+    ap.add_argument("--train_steps", type=int, default=3000)
+    ap.add_argument("--breakdown", action="store_true")
+    ap.add_argument("--trace", type=str, default=None)
+    ap.add_argument("--data_dir", type=str, default="/root/reference/data")
+    args = ap.parse_args()
+
+    import jax
+
+    from fia_tpu.influence.engine import InfluenceEngine
+    from fia_tpu.models import MODELS
+    from fia_tpu.train.trainer import Trainer, TrainConfig
+    from fia_tpu.utils.timing import profile_trace
+
+    if not args.quick and os.path.isdir(args.data_dir):
+        from fia_tpu.data.loaders import load_dataset
+
+        splits = load_dataset("movielens", args.data_dir)
+        train, test = splits["train"], splits["test"]
+        users, items = 6_040, 3_706
+        test_x = test.x
+    else:
+        from fia_tpu.data.synthetic import (
+            sample_heldout_pairs,
+            synthesize_ratings,
+        )
+
+        users, items = 600, 400
+        train = synthesize_ratings(users, items, 50_000, seed=0)
+        test_x = sample_heldout_pairs(train.x, users, items, 2048, seed=17)
+    print(f"ab: backend={jax.default_backend()} train={train.num_examples} "
+          f"model={args.model}", file=sys.stderr, flush=True)
+
+    model = MODELS[args.model](users, items, 16, 1e-3)
+    tr = Trainer(model, TrainConfig(batch_size=3020, num_steps=args.train_steps,
+                                    learning_rate=1e-3))
+    params = tr.fit(
+        tr.init_state(model.init_params(jax.random.PRNGKey(0))),
+        train.x, train.y,
+    ).params
+    print("ab: training done", file=sys.stderr, flush=True)
+
+    variants = {
+        "flat": dict(impl="flat"),
+        "padded": dict(impl="padded"),
+    }
+    engines = {
+        name: InfluenceEngine(model, params, train, damping=1e-6,
+                              solver="direct", pad_bucket=512, **kw)
+        for name, kw in variants.items()
+    }
+
+    # per-round query batches: disjoint slices of the test split so no
+    # two dispatches ever see identical input buffers
+    B = args.batch_queries
+    max_rounds = len(test_x) // B - 1
+    if args.rounds > max_rounds:
+        print(f"ab: capping rounds {args.rounds} -> {max_rounds} "
+              f"(test split holds {len(test_x)} points)",
+              file=sys.stderr, flush=True)
+        args.rounds = max_rounds
+    rng = np.random.default_rng(17)
+    order = rng.permutation(len(test_x))
+    batches = [
+        test_x[order[r * B : (r + 1) * B]] for r in range(args.rounds + 1)
+    ]
+
+    # warm every engine (compile) on batch 0
+    for name, eng in engines.items():
+        t0 = time.perf_counter()
+        eng.query_batch(batches[0])
+        print(f"ab: {name} compile+first {time.perf_counter() - t0:.2f}s",
+              file=sys.stderr, flush=True)
+
+    times = {name: [] for name in engines}
+    scores = {}
+    for r in range(1, args.rounds + 1):
+        for name, eng in engines.items():
+            t0 = time.perf_counter()
+            res = eng.query_batch(batches[r])
+            times[name].append(time.perf_counter() - t0)
+            scores[name] = res
+    n_scores = {name: int(s.counts.sum()) for name, s in scores.items()}
+
+    out = {}
+    for name in engines:
+        best = min(times[name])
+        out[name] = {
+            "best_s": round(best, 4),
+            "all_s": [round(t, 4) for t in times[name]],
+            "queries_per_sec": round(B / best, 1),
+            "scores_per_sec": round(n_scores[name] / best, 1),
+        }
+    # sanity: variants agree on the scores
+    ref = scores["flat"]
+    for name, s in scores.items():
+        for t in range(0, B, 61):
+            np.testing.assert_allclose(
+                s.scores_of(t), ref.scores_of(t), rtol=2e-3, atol=1e-5
+            )
+    out["agree"] = True
+
+    if args.breakdown:
+        eng = engines["flat"]
+        from fia_tpu.data.index import bucketed_pad
+
+        import jax.numpy as jnp
+
+        dev = []
+        e2e = []
+        for r in range(1, args.rounds + 1):
+            p = batches[r]
+            # per-round pad: a fixed pad from round 1 would silently
+            # truncate rounds whose related-row total crosses a bucket
+            s_pad = bucketed_pad(int(eng.index.counts_batch(p).sum()), 2048)
+            fn = eng._flat_fn(s_pad)
+            txr = jnp.asarray(p, jnp.int32)
+            t0 = time.perf_counter()
+            o = fn(eng.params, eng.train_x, eng.train_y, eng._postings, txr)
+            jax.block_until_ready(o)
+            dev.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            eng.query_batch(p)
+            e2e.append(time.perf_counter() - t0)
+        out["breakdown"] = {
+            "device_program_s": round(min(dev), 4),
+            "end_to_end_s": round(min(e2e), 4),
+            "host_assembly_transfer_s": round(min(e2e) - min(dev), 4),
+        }
+
+    if args.trace:
+        with profile_trace(args.trace):
+            engines["flat"].query_batch(batches[1])
+        out["trace_dir"] = args.trace
+
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
