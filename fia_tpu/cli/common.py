@@ -193,9 +193,17 @@ def batch_size_for(args, train) -> int:
     return max(1, min(3000, train.num_examples // 10))
 
 
-def model_name_for(args, wd=None) -> str:
+def model_name_for(args, wd=None, splits=None) -> str:
+    """Checkpoint/model-name key. Pass ``splits`` whenever they are in
+    hand: the train split's generator tag (e.g. 'cal1') is read directly
+    from it, so the name cannot silently drop the tag when a caller
+    never went through load_splits (which stashes the same tag on args
+    as a fallback for split-free paths)."""
     wd = args.weight_decay if wd is None else wd
-    tag = getattr(args, "_synth_tag", "")
+    if splits is not None:
+        tag = getattr(splits["train"], "synth_tag", "")
+    else:
+        tag = getattr(args, "_synth_tag", "")
     return (
         f"{args.dataset}_{args.model}_explicit_damping{args.damping:.0e}"
         f"_avextol{args.avextol:.0e}_embed{args.embed_size}"
@@ -230,7 +238,10 @@ def train_or_load(args, model, params, splits, num_steps=None, verbose=True,
     trainer = Trainer(model, cfg, event_log=event_log, mesh=mesh)
     state = trainer.init_state(params)
 
-    ckpt = os.path.join(args.train_dir, f"{model_name_for(args)}-checkpoint-{num_steps - 1}")
+    ckpt = os.path.join(
+        args.train_dir,
+        f"{model_name_for(args, splits=splits)}-checkpoint-{num_steps - 1}",
+    )
     if args.load_checkpoint and checkpoint.exists(ckpt):
         print(f"Checkpoint found, loading {ckpt}")
         p, o, step = checkpoint.load(ckpt, state.params, state.opt_state)

@@ -39,7 +39,8 @@ def main(argv=None):
 
     engine = InfluenceEngine(
         model, state.params, train, mesh=mesh,
-        cache_dir=args.train_dir, model_name=common.model_name_for(args),
+        cache_dir=args.train_dir,
+        model_name=common.model_name_for(args, splits=splits),
         **common.engine_kwargs(args),
     )
 

@@ -1,0 +1,32 @@
+#!/bin/bash
+# Round-2 chip job chain: waits for the in-flight MF RQ1 (pid $1), then
+# runs the remaining single-occupancy chip jobs sequentially.
+set -u
+cd "$(dirname "$0")/.."
+
+if [ $# -ge 1 ]; then
+  while kill -0 "$1" 2>/dev/null; do sleep 60; done
+fi
+
+echo "chain: $(date) solver agreement" >> output/chain.log
+python scripts/solver_agreement.py \
+  > output/solver_agreement_mf.json 2> output/solver_agreement_mf.log
+
+echo "chain: $(date) NCF decomposition" >> output/chain.log
+python scripts/decompose.py --num_test 2 \
+  > output/decompose_ncf.json 2> output/decompose_ncf.log
+
+echo "chain: $(date) NCF full-protocol RQ1 (18k x 4)" >> output/chain.log
+python -m fia_tpu.cli.rq1 --dataset movielens --data_dir /root/reference/data \
+  --model NCF --num_test 2 --num_steps_train 12000 \
+  --num_steps_retrain 18000 --retrain_times 4 --batch_size 3020 \
+  --lane_chunk 16 --steps_per_dispatch 1000 \
+  > output/rq1_ncf_ml_cal1_full.log 2>&1
+
+echo "chain: $(date) Yelp MF full-protocol RQ1" >> output/chain.log
+python -m fia_tpu.cli.rq1 --dataset yelp --data_dir /root/reference/data \
+  --model MF --num_test 2 --num_steps_train 15000 \
+  --num_steps_retrain 24000 --retrain_times 4 --batch_size 3009 \
+  > output/rq1_mf_yelp_cal1.log 2>&1
+
+echo "chain: $(date) done" >> output/chain.log

@@ -1,0 +1,185 @@
+#!/usr/bin/env python
+"""Decompose the NCF fidelity gap: block approximation vs everything else.
+
+NCF's RQ1 correlation plateaus around r ~ 0.83-0.89 on ML-1M while MF
+reaches ~0.99. The FIA block restriction deliberately EXCLUDES the MLP
+hidden weights from the influence subspace (ref:src/influence/NCF.py:
+43-66), which is the suspected cause — this script proves or refutes it
+by triangulating three score sets on a subsampled train set:
+
+  block  — FIA block-restricted influence (the production engine)
+  full   — FULL-parameter Koh & Liang influence (FullInfluenceEngine,
+           every weight in the subspace; same damping, same ∇r̂ target)
+  actual — leave-one-out retraining ground truth
+
+r(block, full) isolates the block-approximation error with NO retraining
+noise in sight; r(full, actual) bounds what any influence method with
+the full subspace could achieve against this retraining protocol
+(linearization error + retraining noise); r(block, actual) is the
+headline RQ1 number. Pearson r is computed per test point (the two
+estimators scale by 1/|related| vs 1/N — irrelevant within a point).
+
+The train set is a row-subsample of the calibrated ML-1M split so the
+full-space CG oracle (~316k params, HVPs over every row) stays cheap
+enough to run at reference solver settings.
+
+Usage: python scripts/decompose.py [--rows 100000] [--num_test 3]
+       [--model NCF] [--smoke]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The axon (tunneled-TPU) image's sitecustomize re-selects its platform
+# via jax.config at interpreter start, OVERRIDING JAX_PLATFORMS — an
+# explicit CPU ask must be re-applied through jax.config too.
+if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--smoke", action="store_true", help="tiny CPU shapes")
+    ap.add_argument("--model", default="NCF", choices=["MF", "NCF"])
+    ap.add_argument("--rows", type=int, default=100_000,
+                    help="train-subsample size")
+    ap.add_argument("--num_test", type=int, default=3)
+    ap.add_argument("--train_steps", type=int, default=12_000)
+    ap.add_argument("--retrain_steps", type=int, default=6_000)
+    ap.add_argument("--retrain_times", type=int, default=3)
+    ap.add_argument("--num_to_remove", type=int, default=50)
+    ap.add_argument("--lane_chunk", type=int, default=16)
+    ap.add_argument("--data_dir", type=str, default="/root/reference/data")
+    ap.add_argument("--seed", type=int, default=17)
+    args = ap.parse_args()
+
+    import jax
+
+    from fia_tpu.data.dataset import RatingDataset
+    from fia_tpu.eval.metrics import pearson, spearman
+    from fia_tpu.eval.rq1 import test_retraining
+    from fia_tpu.influence.engine import InfluenceEngine
+    from fia_tpu.influence.full import FullInfluenceEngine
+    from fia_tpu.models import MODELS
+    from fia_tpu.train.trainer import Trainer, TrainConfig
+
+    rng = np.random.default_rng(args.seed)
+    if args.smoke:
+        from fia_tpu.data.synthetic import synthetic_splits
+
+        splits = synthetic_splits(120, 80, 8_000, 100, seed=3)
+        train, test = splits["train"], splits["test"]
+        users, items = 120, 80
+        args.train_steps = min(args.train_steps, 600)
+        args.retrain_steps = min(args.retrain_steps, 200)
+        args.num_to_remove = min(args.num_to_remove, 8)
+        batch = 400
+    else:
+        from fia_tpu.data.loaders import load_dataset
+
+        splits = load_dataset("movielens", args.data_dir)
+        full_train, test = splits["train"], splits["test"]
+        users, items = 6_040, 3_706
+        sel = rng.choice(full_train.num_examples, args.rows, replace=False)
+        train = RatingDataset(full_train.x[sel], full_train.y[sel])
+        batch = 1_000
+
+    print(f"decompose: model={args.model} rows={train.num_examples} "
+          f"backend={jax.default_backend()}", file=sys.stderr, flush=True)
+
+    model = MODELS[args.model](users, items, 16, 1e-3)
+    tr = Trainer(model, TrainConfig(batch_size=batch,
+                                    num_steps=args.train_steps,
+                                    learning_rate=1e-3))
+    state = tr.fit(tr.init_state(model.init_params(jax.random.PRNGKey(0))),
+                   train.x, train.y)
+    print("decompose: training done", file=sys.stderr, flush=True)
+
+    engine = InfluenceEngine(model, state.params, train, damping=1e-6,
+                             solver="direct")
+    oracle = FullInfluenceEngine(model, state.params, train, damping=1e-6,
+                                 solver="cg", cg_maxiter=300, cg_tol=1e-12)
+
+    # test points with a usable related set in the subsample
+    cand = rng.permutation(test.num_examples)
+    picked = []
+    for t in cand:
+        u, i = (int(v) for v in test.x[t])
+        if engine.index.related_count(u, i) >= 2 * args.num_to_remove:
+            picked.append(int(t))
+        if len(picked) == args.num_test:
+            break
+
+    results = []
+    for t in picked:
+        point = test.x[t]
+        res = engine.query_batch(point[None, :])
+        block_scores = res.scores_of(0)
+        related = res.related_of(0)
+
+        t0 = time.time()
+        full_all = oracle.get_influence_on_test_prediction(point[None, :])
+        full_scores = full_all[related]
+        solve_s = time.time() - t0
+        r_bf = pearson(block_scores, full_scores)
+        print(f"decompose[test {t}]: r(block, full) = {r_bf:.4f} "
+              f"(oracle solve {solve_s:.0f}s, {len(related)} related rows)",
+              file=sys.stderr, flush=True)
+
+        rt = test_retraining(
+            engine, train, test, t,
+            num_to_remove=args.num_to_remove,
+            num_steps=args.retrain_steps, batch_size=batch,
+            learning_rate=1e-3, retrain_times=args.retrain_times,
+            remove_type="maxinf", lane_chunk=args.lane_chunk,
+            steps_per_dispatch=1_000, verbose=True,
+        )
+        sel_rows = rt.indices_to_remove  # positions into the related set
+        entry = {
+            "test_idx": t,
+            "related": int(len(related)),
+            "r_block_full": float(r_bf),
+            "rs_block_full": float(spearman(block_scores, full_scores)),
+            "r_block_actual": float(pearson(rt.predicted_y_diffs,
+                                            rt.actual_y_diffs)),
+            "r_full_actual": float(pearson(full_scores[sel_rows],
+                                           rt.actual_y_diffs)),
+            "oracle_solve_s": round(solve_s, 1),
+            "bias_retrain": float(rt.bias_retrain),
+        }
+        results.append(entry)
+        print(f"decompose[test {t}]: r(block, actual) = "
+              f"{entry['r_block_actual']:.4f}, r(full, actual) = "
+              f"{entry['r_full_actual']:.4f}", file=sys.stderr, flush=True)
+
+    out = {
+        "model": args.model,
+        "rows": train.num_examples,
+        "train_steps": args.train_steps,
+        "retrain": f"{args.retrain_steps}x{args.retrain_times}",
+        "num_to_remove": args.num_to_remove,
+        "per_test": results,
+        "mean_r_block_full": round(
+            float(np.mean([e["r_block_full"] for e in results])), 4),
+        "mean_r_block_actual": round(
+            float(np.mean([e["r_block_actual"] for e in results])), 4),
+        "mean_r_full_actual": round(
+            float(np.mean([e["r_full_actual"] for e in results])), 4),
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""Solver agreement at reference settings (VERDICT r1 #5 done-criterion).
+
+Runs the same influence query batch through the direct (LU), CG
+(fmin_ncg-equivalent, avextol 1e-3 mapping, maxiter 100) and LiSSA
+(scale 10, depth 10,000 — the reference defaults, genericNeuralNet.py:
+511-544) solvers on the trained calibrated ML-1M checkpoint and reports
+pairwise score correlations. The FIA block system is a damped 34-dim PD
+solve, so all three should agree to high precision when converged.
+
+Usage: python scripts/solver_agreement.py [--smoke] [--model MF]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The axon (tunneled-TPU) image's sitecustomize re-selects its platform
+# via jax.config at interpreter start, OVERRIDING JAX_PLATFORMS — an
+# explicit CPU ask must be re-applied through jax.config too.
+if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--model", default="MF", choices=["MF", "NCF"])
+    ap.add_argument("--num_test", type=int, default=64)
+    ap.add_argument("--train_steps", type=int, default=15_000)
+    ap.add_argument("--lissa_depth", type=int, default=10_000)
+    ap.add_argument("--data_dir", type=str, default="/root/reference/data")
+    args = ap.parse_args()
+
+    import jax
+
+    from fia_tpu.eval.metrics import pearson, spearman
+    from fia_tpu.influence.engine import InfluenceEngine
+    from fia_tpu.models import MODELS
+    from fia_tpu.train.trainer import Trainer, TrainConfig
+
+    if args.smoke:
+        from fia_tpu.data.synthetic import synthetic_splits
+
+        splits = synthetic_splits(300, 200, 20_000, 200, seed=3)
+        users, items, batch = 300, 200, 1_000
+        args.train_steps = min(args.train_steps, 1_000)
+        args.lissa_depth = min(args.lissa_depth, 2_000)
+    else:
+        from fia_tpu.data.loaders import load_dataset
+
+        splits = load_dataset("movielens", args.data_dir)
+        users, items, batch = 6_040, 3_706, 3_020
+    train, test = splits["train"], splits["test"]
+
+    model = MODELS[args.model](users, items, 16, 1e-3)
+    tr = Trainer(model, TrainConfig(batch_size=batch,
+                                    num_steps=args.train_steps,
+                                    learning_rate=1e-3))
+    state = tr.fit(tr.init_state(model.init_params(jax.random.PRNGKey(0))),
+                   train.x, train.y)
+    print("solver_agreement: training done", file=sys.stderr, flush=True)
+
+    rng = np.random.default_rng(17)
+    sel = rng.choice(test.num_examples, args.num_test, replace=False)
+    points = test.x[sel]
+
+    # cg_tol mirrors cli/common.cg_tol_for at the reference avextol=1e-3
+    engines = {
+        "direct": InfluenceEngine(model, state.params, train, damping=1e-6,
+                                  solver="direct"),
+        "cg": InfluenceEngine(model, state.params, train, damping=1e-6,
+                              solver="cg", cg_maxiter=100, cg_tol=1e-9),
+        "lissa": InfluenceEngine(model, state.params, train, damping=1e-6,
+                                 solver="lissa", lissa_scale=10.0,
+                                 lissa_depth=args.lissa_depth),
+    }
+    scores = {}
+    for name, eng in engines.items():
+        res = eng.query_batch(points)
+        scores[name] = [res.scores_of(t) for t in range(len(points))]
+        print(f"solver_agreement: {name} done", file=sys.stderr, flush=True)
+
+    out = {"model": args.model, "num_test": args.num_test,
+           "lissa_depth": args.lissa_depth, "train_steps": args.train_steps}
+    for a, b in (("direct", "cg"), ("direct", "lissa"), ("cg", "lissa")):
+        rs = [pearson(x, y) for x, y in zip(scores[a], scores[b])
+              if len(x) > 1]
+        ss = [spearman(x, y) for x, y in zip(scores[a], scores[b])
+              if len(x) > 1]
+        out[f"{a}_vs_{b}"] = {
+            "pearson_min": round(float(np.min(rs)), 6),
+            "pearson_mean": round(float(np.mean(rs)), 6),
+            "spearman_min": round(float(np.min(ss)), 6),
+        }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
