@@ -444,9 +444,10 @@ class InfluenceEngine:
             and self.model.block_reg_diag is not None
         )
 
-    def _query_flat(
-        self, test_points: np.ndarray, pad_to: int | None = None
-    ) -> InfluenceResult:
+    def _dispatch_flat(self, test_points: np.ndarray, pad_to: int | None):
+        """Enqueue one flat query program; returns an opaque handle for
+        :meth:`_finalize_flat`. Dispatch is async — the device starts
+        crunching while the host moves on."""
         counts = self.index.counts_batch(test_points)
         total = int(counts.sum())
         # geometric bucketing (~12.5% granule): pure powers of two waste
@@ -469,7 +470,52 @@ class InfluenceEngine:
         pad = bucketed_pad(
             counts.max() if counts.size else 1, self.pad_bucket, pad_to
         )
+        return (test_points, counts, out, pad)
+
+    def _finalize_flat(self, handle) -> InfluenceResult:
+        test_points, counts, out, pad = handle
         return self._assemble_packed(test_points, counts, out, pad)
+
+    def _query_flat(
+        self, test_points: np.ndarray, pad_to: int | None = None
+    ) -> InfluenceResult:
+        return self._finalize_flat(self._dispatch_flat(test_points, pad_to))
+
+    def query_many(
+        self,
+        test_points: np.ndarray,
+        batch_queries: int = 256,
+        pad_to: int | None = None,
+        window: int = 4,
+    ) -> list[InfluenceResult]:
+        """Pipelined large workloads: split into query batches, keep up
+        to ``window`` device programs in flight, and finalize in order.
+
+        Host-side result assembly + transfer is ~40% of a single batch's
+        latency on tunnel-attached hosts (BASELINE.md §4); dispatching
+        batch r+1 before fetching batch r overlaps that host work with
+        device compute. Falls back to sequential :meth:`query_batch`
+        whenever the flat path is ineligible. The bounded window caps
+        device-resident output buffers for very long workloads.
+        """
+        test_points = np.asarray(test_points)
+        if test_points.ndim == 1:
+            test_points = test_points[None, :]
+        batches = [
+            test_points[i : i + batch_queries]
+            for i in range(0, len(test_points), batch_queries)
+        ]
+        if not (self.impl in ("auto", "flat") and self._flat_eligible()):
+            return [self.query_batch(b, pad_to=pad_to) for b in batches]
+        results: list[InfluenceResult] = []
+        inflight: list = []
+        for b in batches:
+            inflight.append(self._dispatch_flat(b, pad_to))
+            if len(inflight) >= max(1, window):
+                results.append(self._finalize_flat(inflight.pop(0)))
+        while inflight:
+            results.append(self._finalize_flat(inflight.pop(0)))
+        return results
 
     def _assemble_packed(self, test_points, counts, out, pad: int) -> InfluenceResult:
         """Re-expand flat device outputs into the padded result layout.

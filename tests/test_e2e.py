@@ -135,3 +135,28 @@ class TestCLI:
             "--lr", "1e-2", "--train_dir", str(tmp_path),
         ])
         assert np.isfinite(r)
+
+    def test_rq1_cli_mesh_and_event_log(self, tmp_path):
+        """--mesh 8 runs the whole RQ1 pipeline (training, queries, LOO
+        retraining) sharded on the virtual mesh, and the JSONL event log
+        records every stage (the r1 logging-wiring gap)."""
+        from fia_tpu.cli import rq1
+        from fia_tpu.utils.logging import read_events
+
+        r = rq1.main([
+            "--dataset", "synthetic", "--model", "MF",
+            "--synth_users", "40", "--synth_items", "30",
+            "--synth_train", "1500", "--synth_test", "50",
+            "--num_steps_train", "400", "--num_steps_retrain", "200",
+            "--num_test", "1", "--retrain_times", "1",
+            "--embed_size", "4", "--batch_size", "150",
+            "--lr", "1e-2", "--train_dir", str(tmp_path),
+            "--mesh", "8", "--num_to_remove", "6",
+        ])
+        assert np.isfinite(r)
+        events = {
+            e["event"]
+            for e in read_events(str(tmp_path / "events-rq1-MF-synthetic.jsonl"))
+        }
+        assert {"run_start", "train_epoch", "influence_query",
+                "retrain_chunk", "test_point_done", "run_done"} <= events

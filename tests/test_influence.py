@@ -455,6 +455,22 @@ class TestEngine:
         healed = eng2.get_influence_on_test_loss([0], test_ds, force_refresh=False)
         np.testing.assert_allclose(healed, fresh)
 
+    def test_query_many_pipelined_matches_sequential(self, model_cls):
+        """query_many keeps a window of device programs in flight and
+        finalizes in order; results must equal per-batch query_batch."""
+        model, params, train = _setup(model_cls)
+        eng = InfluenceEngine(model, params, train, damping=DAMP)
+        pts = np.array([[3, 5], [0, 1], [7, 2], [1, 1], [0, 4], [5, 3], [2, 2]])
+        many = eng.query_many(pts, batch_queries=3, window=2)
+        assert len(many) == 3  # 3 + 3 + 1
+        seq = [eng.query_batch(pts[i : i + 3]) for i in (0, 3, 6)]
+        for got, want in zip(many, seq):
+            assert np.array_equal(got.counts, want.counts)
+            for t in range(got.scores.shape[0]):
+                np.testing.assert_allclose(
+                    got.scores_of(t), want.scores_of(t), rtol=1e-5, atol=1e-7
+                )
+
     def test_cache_guards_against_different_train_set(self, model_cls, tmp_path):
         """Identical params over a leave-one-out train subset must not be
         served the full set's cached scores (ADVICE r1): the train
