@@ -56,10 +56,23 @@ class FullInfluenceEngine:
         self.lissa_batch = int(lissa_batch)
         self.mesh = mesh
 
+        # flat layout derived from HOST copies before any cross-process
+        # placement: ravel runs plain (non-jit) ops, which global arrays
+        # don't support — the flat vector is then placed globally below.
+        # np.asarray also accepts already-global fully-replicated params
+        # (e.g. a trained state handed over from a multi-host Trainer).
+        flat, unravel = ravel_pytree(
+            jax.tree_util.tree_map(np.asarray, params)
+        )
+        self._unravel = unravel
+        self.num_params = flat.shape[0]
+
         self.train_x = jnp.asarray(train.x)
         self.train_y = jnp.asarray(train.y)
+        self._multihost = False
         if mesh is not None:
-            shard = NamedSharding(mesh, P("data"))
+            from fia_tpu.parallel.distributed import put_global, spans_processes
+
             n = train.num_examples
             # divisibility is only needed along the sharded 'data' axis —
             # n % devices.size would needlessly drop rows on 2-D meshes
@@ -67,18 +80,30 @@ class FullInfluenceEngine:
             if drop:  # keep shards equal; influence over N-drop rows
                 self.train_x = self.train_x[: n - drop]
                 self.train_y = self.train_y[: n - drop]
-            self.train_x = jax.device_put(self.train_x, shard)
-            self.train_y = jax.device_put(self.train_y, shard)
-            params = jax.tree_util.tree_map(
-                lambda a: jax.device_put(jnp.asarray(a), NamedSharding(mesh, P())),
-                params,
-            )
+            self._multihost = spans_processes(mesh)
+            if self._multihost:
+                # every process holds the same host copies; build global
+                # arrays (device_put cannot target non-addressable devices)
+                self.train_x = put_global(
+                    mesh, np.asarray(self.train_x), P("data")
+                )
+                self.train_y = put_global(
+                    mesh, np.asarray(self.train_y), P("data")
+                )
+                params = put_global(mesh, params, P())
+                flat = put_global(mesh, np.asarray(flat), P())
+            else:
+                shard = NamedSharding(mesh, P("data"))
+                self.train_x = jax.device_put(self.train_x, shard)
+                self.train_y = jax.device_put(self.train_y, shard)
+                params = jax.tree_util.tree_map(
+                    lambda a: jax.device_put(
+                        jnp.asarray(a), NamedSharding(mesh, P())
+                    ),
+                    params,
+                )
         self.params = jax.tree_util.tree_map(jnp.asarray, params)
-
-        flat, unravel = ravel_pytree(self.params)
-        self._flat0 = flat
-        self._unravel = unravel
-        self.num_params = flat.shape[0]
+        self._flat0 = jnp.asarray(flat)
         self.num_train = int(self.train_x.shape[0])
 
         # Chunked HVP: one full-batch double-backprop program over
@@ -97,13 +122,17 @@ class FullInfluenceEngine:
             self.hvp_batch = b
 
     # -- core pieces -------------------------------------------------------
-    def _total_loss_flat(self, fvec):
-        return self.model.loss(self._unravel(fvec), self.train_x, self.train_y)
+    # The jitted entry points take flat0/train tensors as ARGUMENTS, not
+    # closures: a jit may not close over cross-process global arrays.
 
-    def _hvp(self, v):
+    def _hvp_of(self, flat0, train_x, train_y, v):
         n = self.num_train
         if self.hvp_batch <= 0 or self.hvp_batch >= n:
-            hv = jax.jvp(jax.grad(self._total_loss_flat), (self._flat0,), (v,))[1]
+
+            def total(fvec):
+                return self.model.loss(self._unravel(fvec), train_x, train_y)
+
+            hv = jax.jvp(jax.grad(total), (flat0,), (v,))[1]
             return hv + self.damping * v
         b = self.hvp_batch
         nb = -(-n // b)
@@ -114,7 +143,7 @@ class FullInfluenceEngine:
             gidx = ci * b + iota
             w = (gidx < n).astype(jnp.float32)
             idx = jnp.where(gidx < n, gidx, 0)
-            x, y = self.train_x[idx], self.train_y[idx]
+            x, y = train_x[idx], train_y[idx]
             if mesh is not None:
                 c = lambda a: jax.lax.with_sharding_constraint(
                     a, NamedSharding(
@@ -127,7 +156,7 @@ class FullInfluenceEngine:
                 p = self._unravel(fvec)
                 return jnp.sum(self.model.indiv_loss(p, x, y) * w)
 
-            hv = jax.jvp(jax.grad(loss_sum), (self._flat0,), (v,))[1]
+            hv = jax.jvp(jax.grad(loss_sum), (flat0,), (v,))[1]
             return acc + hv, None
 
         err_hv = jax.lax.scan(
@@ -135,49 +164,66 @@ class FullInfluenceEngine:
         )[0] / n
         reg_hv = jax.jvp(
             jax.grad(lambda f: self.model.reg_loss(self._unravel(f))),
-            (self._flat0,), (v,),
+            (flat0,), (v,),
         )[1]
         return err_hv + reg_hv + self.damping * v
 
-    def _lissa_sample_hvp(self, key):
+    def _hvp(self, v):
+        """Single-host convenience wrapper (spectral probes, tests)."""
+        return self._hvp_of(self._flat0, self.train_x, self.train_y, v)
+
+    def _lissa_sample_hvp(self, flat0, train_x, train_y, key):
         n = self.num_train
         b = self.lissa_batch
 
         def sample_hvp(j, v):
             idx = jax.random.randint(jax.random.fold_in(key, j), (b,), 0, n)
-            x, y = self.train_x[idx], self.train_y[idx]
+            x, y = train_x[idx], train_y[idx]
 
             def loss(fvec):
                 return self.model.loss(self._unravel(fvec), x, y)
 
-            hv = jax.jvp(jax.grad(loss), (self._flat0,), (v,))[1]
+            hv = jax.jvp(jax.grad(loss), (flat0,), (v,))[1]
             return hv + self.damping * v
 
         return sample_hvp
 
+    @partial(jax.jit, static_argnums=0)
+    def _test_loss_grad_jit(self, flat0, tx, ty):
+        def loss(fvec):
+            return self.model.loss_no_reg(self._unravel(fvec), tx, ty)
+
+        return jax.grad(loss)(flat0)
+
     def test_loss_grad(self, test_x, test_y):
         """v = ∇_θ of the mean test loss WITHOUT regularisation
-        (reference ``grad_loss_no_reg_op``, genericNeuralNet.py:154)."""
+        (reference ``grad_loss_no_reg_op``, genericNeuralNet.py:154).
 
-        def loss(fvec):
-            return self.model.loss_no_reg(
-                self._unravel(fvec), jnp.asarray(test_x), jnp.asarray(test_y)
-            )
-
-        return jax.grad(loss)(self._flat0)
+        Method-level jit (shape-keyed cache reuse across calls — a fresh
+        ``jax.jit(closure)`` per call would recompile every time) with
+        test data as arguments; jit rather than eager grad because
+        multi-process global params only support compiled SPMD programs.
+        """
+        return self._test_loss_grad_jit(
+            self._flat0, np.asarray(test_x), np.asarray(test_y)
+        )
 
     @partial(jax.jit, static_argnums=0)
-    def _solve(self, v, key):
+    def _solve(self, v, seed, flat0, train_x, train_y):
+        hvp = lambda w: self._hvp_of(flat0, train_x, train_y, w)
         if self.solver == "cg":
             return solvers.solve_cg(
-                self._hvp, v, maxiter=self.cg_maxiter, tol=self.cg_tol
+                hvp, v, maxiter=self.cg_maxiter, tol=self.cg_tol
             )
         elif self.solver == "lissa":
             sample = (
-                self._lissa_sample_hvp(key) if self.lissa_batch else None
+                self._lissa_sample_hvp(flat0, train_x, train_y,
+                                       jax.random.PRNGKey(seed))
+                if self.lissa_batch
+                else None
             )
             return solvers.solve_lissa(
-                self._hvp,
+                hvp,
                 v,
                 scale=self.lissa_scale,
                 recursion_depth=self.lissa_depth,
@@ -186,10 +232,11 @@ class FullInfluenceEngine:
         raise ValueError(f"unknown solver {self.solver!r}")
 
     def get_inverse_hvp(self, v, seed: int = 0):
-        return self._solve(jnp.asarray(v), jax.random.PRNGKey(seed))
+        return self._solve(jnp.asarray(v), np.uint32(seed), self._flat0,
+                           self.train_x, self.train_y)
 
     @partial(jax.jit, static_argnums=0)
-    def _score_all(self, u):
+    def _score_all(self, u, flat0, train_x, train_y):
         """dot(∇_θ L_total(z_j), u) / N for every train row j.
 
         Per-example total loss = own squared error + full regulariser, so
@@ -199,30 +246,43 @@ class FullInfluenceEngine:
 
         def indiv(fvec):
             p = self._unravel(fvec)
-            return self.model.indiv_loss(p, self.train_x, self.train_y)
+            return self.model.indiv_loss(p, train_x, train_y)
 
-        _, err_dots = jax.jvp(indiv, (self._flat0,), (u,))
+        _, err_dots = jax.jvp(indiv, (flat0,), (u,))
         reg_dot = jax.jvp(
-            lambda f: self.model.reg_loss(self._unravel(f)), (self._flat0,), (u,)
+            lambda f: self.model.reg_loss(self._unravel(f)), (flat0,), (u,)
         )[1]
         return (err_dots + reg_dot) / self.num_train
+
+    def _fetch(self, arr) -> np.ndarray:
+        """Host copy of a (possibly cross-process sharded) result."""
+        if self._multihost:
+            from jax.experimental import multihost_utils
+
+            return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
+        return np.asarray(arr)
 
     # -- public API --------------------------------------------------------
     def get_influence_on_test_loss(self, test_x, test_y, seed: int = 0):
         """Predicted test-LOSS change per removed train row, (N,)."""
         v = self.test_loss_grad(test_x, test_y)
         ihvp = self.get_inverse_hvp(v, seed=seed)
-        return np.asarray(self._score_all(ihvp))
+        return self._fetch(
+            self._score_all(ihvp, self._flat0, self.train_x, self.train_y)
+        )
+
+    @partial(jax.jit, static_argnums=0)
+    def _pred_grad_jit(self, flat0, tx):
+        def pred(fvec):
+            return jnp.mean(self.model.predict(self._unravel(fvec), tx))
+
+        return jax.grad(pred)(flat0)
 
     def get_influence_on_test_prediction(self, test_x, seed: int = 0):
         """Predicted test-PREDICTION change per removed train row (the
         quantity FIA approximates in the block subspace)."""
-
-        def pred(fvec):
-            return jnp.mean(
-                self.model.predict(self._unravel(fvec), jnp.asarray(test_x))
-            )
-
-        v = jax.grad(pred)(self._flat0)
+        v = self._pred_grad_jit(self._flat0, np.asarray(test_x))
         ihvp = self.get_inverse_hvp(v, seed=seed)
-        return np.asarray(self._score_all(ihvp))
+        return self._fetch(
+            self._score_all(ihvp, self._flat0, self.train_x, self.train_y)
+        )

@@ -166,13 +166,9 @@ def main() -> None:
 
         from fia_tpu.influence.full import FullInfluenceEngine
 
-        # FullInfluenceEngine places tensors with plain device_put — fine
-        # for local (possibly multi-device) meshes, unsupported across
-        # processes; fall back to this process's devices there.
-        fs_mesh = None if (mesh is not None and dist.spans_processes(mesh)) else mesh
         fe = FullInfluenceEngine(
             model, state.params, train, damping=1e-4, solver="cg",
-            cg_maxiter=10, hvp_batch=args.hvp_batch, mesh=fs_mesh,
+            cg_maxiter=10, hvp_batch=args.hvp_batch, mesh=mesh,
         )
         print(f"stress: full-space probe ({fe.num_params} params, "
               f"{fe.num_train} rows, hvp_batch={fe.hvp_batch})",

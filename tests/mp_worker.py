@@ -78,8 +78,20 @@ def main() -> None:
     pts = np.array([[3, 5], [0, 1], [7, 2], [11, 9]], np.int32)
     res = engine.query_batch(pts, pad_to=args.pad_to)
 
+    # full-parameter engine over the same cross-process mesh: train rows
+    # shard over 'data' (chunked HVP), params replicated, result
+    # allgathered — every process gets the full (N,) score vector
+    from fia_tpu.influence.full import FullInfluenceEngine
+
+    full = FullInfluenceEngine(model, params, train, damping=1.0,
+                               solver="cg", cg_maxiter=50, mesh=mesh,
+                               hvp_batch=100)
+    full_scores = full.get_influence_on_test_loss(x[:2], y[:2])
+    assert full_scores.shape[0] == full.num_train
+
     if args.process_id == 0:
-        np.savez(args.out, scores=res.scores, counts=res.counts)
+        np.savez(args.out, scores=res.scores, counts=res.counts,
+                 full_scores=full_scores)
     print(f"worker {args.process_id}: ok", flush=True)
 
 

@@ -210,3 +210,12 @@ class TestTwoProcess:
                 got["scores"][t, : base.counts[t]], base.scores_of(t),
                 rtol=1e-4, atol=1e-6,
             )
+        # full-parameter engine across processes == single-process run
+        from fia_tpu.influence.full import FullInfluenceEngine
+
+        full_base = FullInfluenceEngine(
+            model, params, train, damping=1.0, solver="cg", cg_maxiter=50,
+            hvp_batch=100,
+        ).get_influence_on_test_loss(x[:2], y[:2])
+        np.testing.assert_allclose(got["full_scores"], full_base,
+                                   rtol=1e-3, atol=1e-7)
