@@ -74,6 +74,7 @@ class FIAModel:
             model,
             TrainConfig(batch_size=batch_size, num_steps=0,
                         learning_rate=initial_learning_rate, seed=seed),
+            mesh=mesh,
         )
         params = model.init_params(jax.random.PRNGKey(seed))
         self.state = self._trainer.init_state(params)

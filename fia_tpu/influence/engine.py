@@ -24,7 +24,6 @@ compact outputs in a single host round trip (see docs/design.md §2).
 from __future__ import annotations
 
 import os
-from dataclasses import dataclass
 from functools import partial
 
 import jax
@@ -39,22 +38,77 @@ from fia_tpu.influence import hvp as H
 from fia_tpu.influence import solvers
 
 
-@dataclass
 class InfluenceResult:
-    """Batched influence query results (T test points, P padded rows)."""
+    """Batched influence query results (T test points, P padded rows).
 
-    scores: np.ndarray  # (T, P) predicted rating diffs, 0 on padding
-    related_idx: np.ndarray  # (T, P) train-row ids
-    related_mask: np.ndarray  # (T, P) bool
-    counts: np.ndarray  # (T,)
-    ihvp: np.ndarray  # (T, d) inverse-HVP vectors
-    test_grad: np.ndarray  # (T, d) test-side vectors v
+    The flat path stores results PACKED (one flat score array in query
+    order plus counts) and synthesizes the padded ``scores``/
+    ``related_idx``/``related_mask`` views lazily on first access —
+    building (T, P) padded host arrays was a measurable share of query
+    latency, and the common consumers (``scores_of``/``related_of``)
+    never need them.
+    """
 
+    def __init__(self, scores=None, related_idx=None, related_mask=None,
+                 counts=None, ihvp=None, test_grad=None,
+                 packed=None, test_points=None, index=None, pad=None):
+        self.counts = counts
+        self.ihvp = ihvp
+        self.test_grad = test_grad
+        self._scores = scores
+        self._related_idx = related_idx
+        self._related_mask = related_mask
+        self._packed = packed
+        self._test_points = test_points
+        self._index = index
+        self._pad = pad
+        self._offsets = None
+        if packed is not None:
+            self._offsets = np.concatenate(
+                [[0], np.cumsum(np.asarray(counts, np.int64))]
+            )
+
+    # -- padded views (lazy for packed results) ---------------------------
+    def _materialize(self):
+        rel_idx, rel_mask, _ = self._index.related_padded(
+            self._test_points, pad_to=self._pad
+        )
+        T = len(self._test_points)
+        scores = np.zeros((T, self._pad), np.float32)
+        scores[rel_mask] = self._packed
+        self._scores = scores
+        self._related_idx = rel_idx
+        self._related_mask = rel_mask
+
+    @property
+    def scores(self) -> np.ndarray:  # (T, P), 0 on padding
+        if self._scores is None:
+            self._materialize()
+        return self._scores
+
+    @property
+    def related_idx(self) -> np.ndarray:  # (T, P) train-row ids
+        if self._related_idx is None:
+            self._materialize()
+        return self._related_idx
+
+    @property
+    def related_mask(self) -> np.ndarray:  # (T, P) bool
+        if self._related_mask is None:
+            self._materialize()
+        return self._related_mask
+
+    # -- per-query accessors (no padding required) ------------------------
     def scores_of(self, t: int) -> np.ndarray:
         """Unpadded scores for test point t (reference return value)."""
+        if self._packed is not None:
+            return self._packed[self._offsets[t] : self._offsets[t + 1]]
         return self.scores[t, : self.counts[t]]
 
     def related_of(self, t: int) -> np.ndarray:
+        if self._packed is not None:
+            u, i = (int(v) for v in self._test_points[t])
+            return self._index.related(u, i)
         return self.related_idx[t, : self.counts[t]]
 
 
@@ -518,26 +572,25 @@ class InfluenceEngine:
         return results
 
     def _assemble_packed(self, test_points, counts, out, pad: int) -> InfluenceResult:
-        """Re-expand flat device outputs into the padded result layout.
+        """Wrap flat device outputs as a packed (lazily padded) result.
 
         One device_get for all outputs (separate per-array fetches
-        serialise into host round trips); row ids/mask from the host CSR,
-        whose contiguous-prefix mask rows consume the packed scores in
-        device order (user postings then item postings).
+        serialise into host round trips). The padded (T, P) views are
+        synthesized on first access from the host CSR, whose
+        contiguous-prefix mask rows consume the packed scores in device
+        order (user postings then item postings) — consumers reading
+        ``scores_of``/``related_of`` never pay for padding.
         """
         packed, ihvp, v = jax.device_get(out)
-        T = test_points.shape[0]
         total = int(counts.sum())
-        rel_idx, rel_mask, _ = self.index.related_padded(test_points, pad_to=pad)
-        scores_np = np.zeros((T, pad), np.float32)
-        scores_np[rel_mask] = packed[:total]
         return InfluenceResult(
-            scores=scores_np,
-            related_idx=rel_idx,
-            related_mask=rel_mask,
             counts=counts,
             ihvp=ihvp,
             test_grad=v,
+            packed=packed[:total],
+            test_points=np.asarray(test_points),
+            index=self.index,
+            pad=pad,
         )
 
     def _batched_packed(self, pad: int, s: int):
