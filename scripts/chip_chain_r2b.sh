@@ -1,0 +1,42 @@
+#!/bin/bash
+# Round-2 chip chain, part B: RQ2 re-measures on the calibrated stream,
+# the fixed-pairing impl A/B, and a full bench. Waits for part A (pid $1).
+set -u
+cd "$(dirname "$0")/.."
+
+if [ $# -ge 1 ]; then
+  while kill -0 "$1" 2>/dev/null; do sleep 60; done
+fi
+
+echo "chainB: $(date) RQ2 movielens MF" >> output/chain.log
+python -m fia_tpu.cli.rq2 --dataset movielens --data_dir /root/reference/data \
+  --model MF --num_test 256 --num_steps_train 15000 --batch_size 3020 \
+  > output/rq2_mf_ml_cal1.log 2>&1
+
+echo "chainB: $(date) RQ2 movielens NCF" >> output/chain.log
+python -m fia_tpu.cli.rq2 --dataset movielens --data_dir /root/reference/data \
+  --model NCF --num_test 256 --num_steps_train 12000 --batch_size 3020 \
+  > output/rq2_ncf_ml_cal1.log 2>&1
+
+echo "chainB: $(date) RQ2 yelp MF" >> output/chain.log
+python -m fia_tpu.cli.rq2 --dataset yelp --data_dir /root/reference/data \
+  --model MF --num_test 256 --num_steps_train 15000 --batch_size 3009 \
+  > output/rq2_mf_yelp_cal1.log 2>&1
+
+echo "chainB: $(date) RQ2 yelp NCF" >> output/chain.log
+python -m fia_tpu.cli.rq2 --dataset yelp --data_dir /root/reference/data \
+  --model NCF --num_test 256 --num_steps_train 12000 --batch_size 3009 \
+  > output/rq2_ncf_yelp_cal1.log 2>&1
+
+echo "chainB: $(date) impl A/B (fixed pairing) MF" >> output/chain.log
+python scripts/ab_impls.py --rounds 6 --breakdown \
+  > output/ab_impls_mf.json 2> output/ab_impls_mf.log
+
+echo "chainB: $(date) impl A/B NCF" >> output/chain.log
+python scripts/ab_impls.py --rounds 4 --model NCF --train_steps 2000 \
+  > output/ab_impls_ncf.json 2> output/ab_impls_ncf.log
+
+echo "chainB: $(date) full bench" >> output/chain.log
+python bench.py > output/bench_r2_preview.json 2> output/bench_r2_preview.log
+
+echo "chainB: $(date) done" >> output/chain.log
