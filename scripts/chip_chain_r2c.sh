@@ -1,0 +1,60 @@
+#!/bin/bash
+# Round-2 chip chain, part C: waits for the TPU tunnel to recover, then
+# runs the remaining chip jobs (NCF full-protocol RQ1, Yelp MF RQ1, RQ2
+# re-measures, impl A/Bs, full bench) sequentially.
+set -u
+cd "$(dirname "$0")/.."
+
+echo "chainC: $(date) waiting for tunnel" >> output/chain.log
+until timeout 60 python -c \
+  "import jax, jax.numpy as jnp; jnp.ones(()).block_until_ready()" \
+  >/dev/null 2>&1; do
+  sleep 60
+done
+echo "chainC: $(date) tunnel up" >> output/chain.log
+
+echo "chainC: $(date) NCF full-protocol RQ1 (18k x 4)" >> output/chain.log
+python -m fia_tpu.cli.rq1 --dataset movielens --data_dir /root/reference/data \
+  --model NCF --num_test 2 --num_steps_train 12000 \
+  --num_steps_retrain 18000 --retrain_times 4 --batch_size 3020 \
+  --lane_chunk 16 --steps_per_dispatch 1000 \
+  > output/rq1_ncf_ml_cal1_full.log 2>&1
+
+echo "chainC: $(date) Yelp MF full-protocol RQ1" >> output/chain.log
+python -m fia_tpu.cli.rq1 --dataset yelp --data_dir /root/reference/data \
+  --model MF --num_test 2 --num_steps_train 15000 \
+  --num_steps_retrain 24000 --retrain_times 4 --batch_size 3009 \
+  > output/rq1_mf_yelp_cal1.log 2>&1
+
+echo "chainC: $(date) RQ2 movielens MF" >> output/chain.log
+python -m fia_tpu.cli.rq2 --dataset movielens --data_dir /root/reference/data \
+  --model MF --num_test 256 --num_steps_train 15000 --batch_size 3020 \
+  > output/rq2_mf_ml_cal1.log 2>&1
+
+echo "chainC: $(date) RQ2 movielens NCF" >> output/chain.log
+python -m fia_tpu.cli.rq2 --dataset movielens --data_dir /root/reference/data \
+  --model NCF --num_test 256 --num_steps_train 12000 --batch_size 3020 \
+  > output/rq2_ncf_ml_cal1.log 2>&1
+
+echo "chainC: $(date) RQ2 yelp MF" >> output/chain.log
+python -m fia_tpu.cli.rq2 --dataset yelp --data_dir /root/reference/data \
+  --model MF --num_test 256 --num_steps_train 15000 --batch_size 3009 \
+  > output/rq2_mf_yelp_cal1.log 2>&1
+
+echo "chainC: $(date) RQ2 yelp NCF" >> output/chain.log
+python -m fia_tpu.cli.rq2 --dataset yelp --data_dir /root/reference/data \
+  --model NCF --num_test 256 --num_steps_train 12000 --batch_size 3009 \
+  > output/rq2_ncf_yelp_cal1.log 2>&1
+
+echo "chainC: $(date) impl A/B (fixed pairing) MF" >> output/chain.log
+python scripts/ab_impls.py --rounds 6 --breakdown \
+  > output/ab_impls_mf.json 2> output/ab_impls_mf.log
+
+echo "chainC: $(date) impl A/B NCF" >> output/chain.log
+python scripts/ab_impls.py --rounds 4 --model NCF --train_steps 2000 \
+  > output/ab_impls_ncf.json 2> output/ab_impls_ncf.log
+
+echo "chainC: $(date) full bench" >> output/chain.log
+python bench.py > output/bench_r2_preview.json 2> output/bench_r2_preview.log
+
+echo "chainC: $(date) done" >> output/chain.log
