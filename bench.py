@@ -245,6 +245,12 @@ def main():
     log.log("run_done", value=out["value"], vs_baseline=out["vs_baseline"])
     log.close()
     print(json.dumps(out))
+    # optional file copy of the JSON line (orchestration scripts merge
+    # stdout into their watch logs); stdout stays the primary contract
+    if "--json_out" in sys.argv:
+        path = sys.argv[sys.argv.index("--json_out") + 1]
+        with open(path, "w") as fh:
+            fh.write(json.dumps(out) + "\n")
 
 
 if __name__ == "__main__":

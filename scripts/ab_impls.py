@@ -52,6 +52,8 @@ def main():
     ap.add_argument("--train_steps", type=int, default=3000)
     ap.add_argument("--breakdown", action="store_true")
     ap.add_argument("--trace", type=str, default=None)
+    ap.add_argument("--out", type=str, default=None,
+                    help="also write the result JSON to this path")
     ap.add_argument("--data_dir", type=str, default="/root/reference/data")
     args = ap.parse_args()
 
@@ -198,6 +200,9 @@ def main():
         out["trace_dir"] = args.trace
 
     print(json.dumps(out))
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(json.dumps(out) + "\n")
 
 
 if __name__ == "__main__":

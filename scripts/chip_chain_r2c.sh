@@ -1,60 +1,99 @@
 #!/bin/bash
-# Round-2 chip chain, part C: waits for the TPU tunnel to recover, then
-# runs the remaining chip jobs (NCF full-protocol RQ1, Yelp MF RQ1, RQ2
-# re-measures, impl A/Bs, full bench) sequentially.
+# Round-2 chip chain, part C: waits for the TPU tunnel, then runs the
+# remaining chip jobs sequentially. Each job runs under a stall
+# watchdog: if its log stops growing for STALL_S seconds (a wedged
+# tunnel client blocks forever, observed 18:27), the job is killed, the
+# tunnel re-probed, and the job retried once.
 set -u
 cd "$(dirname "$0")/.."
+STALL_S=${STALL_S:-1500}
+
+wait_tunnel() {
+  until timeout 60 python -c \
+    "import jax, jax.numpy as jnp; jnp.ones(()).block_until_ready()" \
+    >/dev/null 2>&1; do
+    sleep 60
+  done
+}
+
+run_watched() {  # run_watched <name> <logfile> <cmd...>
+  local name="$1" log="$2"; shift 2
+  local attempt
+  for attempt in 1 2; do
+    echo "chainC: $(date) $name (attempt $attempt)" >> output/chain.log
+    "$@" > "$log" 2>&1 &
+    local pid=$!
+    local last_size=-1 stalled=0
+    while kill -0 "$pid" 2>/dev/null; do
+      sleep 60
+      local size
+      size=$(stat -c %s "$log" 2>/dev/null || echo 0)
+      if [ "$size" -eq "$last_size" ]; then
+        stalled=$((stalled + 60))
+      else
+        stalled=0
+        last_size=$size
+      fi
+      if [ "$stalled" -ge "$STALL_S" ]; then
+        echo "chainC: $(date) $name STALLED (${STALL_S}s no log growth); killing" >> output/chain.log
+        kill "$pid" 2>/dev/null
+        sleep 5
+        kill -9 "$pid" 2>/dev/null
+        break
+      fi
+    done
+    wait "$pid" 2>/dev/null
+    local rc=$?
+    if [ "$stalled" -lt "$STALL_S" ] && [ "$rc" -eq 0 ]; then
+      echo "chainC: $(date) $name ok" >> output/chain.log
+      return 0
+    fi
+    echo "chainC: $(date) $name failed (rc=$rc); re-probing tunnel" >> output/chain.log
+    wait_tunnel
+  done
+  echo "chainC: $(date) $name GAVE UP after 2 attempts" >> output/chain.log
+  return 1
+}
 
 echo "chainC: $(date) waiting for tunnel" >> output/chain.log
-until timeout 60 python -c \
-  "import jax, jax.numpy as jnp; jnp.ones(()).block_until_ready()" \
-  >/dev/null 2>&1; do
-  sleep 60
-done
+wait_tunnel
 echo "chainC: $(date) tunnel up" >> output/chain.log
 
-echo "chainC: $(date) NCF full-protocol RQ1 (18k x 4)" >> output/chain.log
-python -m fia_tpu.cli.rq1 --dataset movielens --data_dir /root/reference/data \
+run_watched "NCF full-protocol RQ1 (18k x 4)" output/rq1_ncf_ml_cal1_full.log \
+  python -m fia_tpu.cli.rq1 --dataset movielens --data_dir /root/reference/data \
   --model NCF --num_test 2 --num_steps_train 12000 \
   --num_steps_retrain 18000 --retrain_times 4 --batch_size 3020 \
-  --lane_chunk 16 --steps_per_dispatch 1000 \
-  > output/rq1_ncf_ml_cal1_full.log 2>&1
+  --lane_chunk 16 --steps_per_dispatch 1000
 
-echo "chainC: $(date) Yelp MF full-protocol RQ1" >> output/chain.log
-python -m fia_tpu.cli.rq1 --dataset yelp --data_dir /root/reference/data \
+run_watched "RQ2 movielens MF" output/rq2_mf_ml_cal1.log \
+  python -m fia_tpu.cli.rq2 --dataset movielens --data_dir /root/reference/data \
+  --model MF --num_test 256 --num_steps_train 15000 --batch_size 3020
+
+run_watched "RQ2 movielens NCF" output/rq2_ncf_ml_cal1.log \
+  python -m fia_tpu.cli.rq2 --dataset movielens --data_dir /root/reference/data \
+  --model NCF --num_test 256 --num_steps_train 12000 --batch_size 3020
+
+run_watched "RQ2 yelp MF" output/rq2_mf_yelp_cal1.log \
+  python -m fia_tpu.cli.rq2 --dataset yelp --data_dir /root/reference/data \
+  --model MF --num_test 256 --num_steps_train 15000 --batch_size 3009
+
+run_watched "RQ2 yelp NCF" output/rq2_ncf_yelp_cal1.log \
+  python -m fia_tpu.cli.rq2 --dataset yelp --data_dir /root/reference/data \
+  --model NCF --num_test 256 --num_steps_train 12000 --batch_size 3009
+
+run_watched "impl A/B MF" output/ab_impls_mf.log \
+  python scripts/ab_impls.py --rounds 6 --breakdown --out output/ab_impls_mf.json
+
+run_watched "impl A/B NCF" output/ab_impls_ncf.log \
+  python scripts/ab_impls.py --rounds 4 --model NCF --train_steps 2000 \
+  --out output/ab_impls_ncf.json
+
+run_watched "Yelp MF full-protocol RQ1" output/rq1_mf_yelp_cal1.log \
+  python -m fia_tpu.cli.rq1 --dataset yelp --data_dir /root/reference/data \
   --model MF --num_test 2 --num_steps_train 15000 \
-  --num_steps_retrain 24000 --retrain_times 4 --batch_size 3009 \
-  > output/rq1_mf_yelp_cal1.log 2>&1
+  --num_steps_retrain 24000 --retrain_times 4 --batch_size 3009
 
-echo "chainC: $(date) RQ2 movielens MF" >> output/chain.log
-python -m fia_tpu.cli.rq2 --dataset movielens --data_dir /root/reference/data \
-  --model MF --num_test 256 --num_steps_train 15000 --batch_size 3020 \
-  > output/rq2_mf_ml_cal1.log 2>&1
-
-echo "chainC: $(date) RQ2 movielens NCF" >> output/chain.log
-python -m fia_tpu.cli.rq2 --dataset movielens --data_dir /root/reference/data \
-  --model NCF --num_test 256 --num_steps_train 12000 --batch_size 3020 \
-  > output/rq2_ncf_ml_cal1.log 2>&1
-
-echo "chainC: $(date) RQ2 yelp MF" >> output/chain.log
-python -m fia_tpu.cli.rq2 --dataset yelp --data_dir /root/reference/data \
-  --model MF --num_test 256 --num_steps_train 15000 --batch_size 3009 \
-  > output/rq2_mf_yelp_cal1.log 2>&1
-
-echo "chainC: $(date) RQ2 yelp NCF" >> output/chain.log
-python -m fia_tpu.cli.rq2 --dataset yelp --data_dir /root/reference/data \
-  --model NCF --num_test 256 --num_steps_train 12000 --batch_size 3009 \
-  > output/rq2_ncf_yelp_cal1.log 2>&1
-
-echo "chainC: $(date) impl A/B (fixed pairing) MF" >> output/chain.log
-python scripts/ab_impls.py --rounds 6 --breakdown \
-  > output/ab_impls_mf.json 2> output/ab_impls_mf.log
-
-echo "chainC: $(date) impl A/B NCF" >> output/chain.log
-python scripts/ab_impls.py --rounds 4 --model NCF --train_steps 2000 \
-  > output/ab_impls_ncf.json 2> output/ab_impls_ncf.log
-
-echo "chainC: $(date) full bench" >> output/chain.log
-python bench.py > output/bench_r2_preview.json 2> output/bench_r2_preview.log
+run_watched "full bench" output/bench_r2_preview.log \
+  python bench.py --json_out output/bench_r2_preview.json
 
 echo "chainC: $(date) done" >> output/chain.log
