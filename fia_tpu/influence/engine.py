@@ -299,8 +299,13 @@ class InfluenceEngine:
         elif self.solver == "cg":
             ihvp = solvers.solve_cg(hvp, v, maxiter=self.cg_maxiter, tol=self.cg_tol)
         else:
+            # no num_samples here: the block HVP is DETERMINISTIC (full
+            # related set every step), so averaged recursions would be
+            # bit-identical — multi-sample averaging lives on the full
+            # engine, whose minibatched sample_hvp is stochastic
             ihvp = solvers.solve_lissa(
-                hvp, v, scale=self.lissa_scale, recursion_depth=self.lissa_depth
+                hvp, v, scale=self.lissa_scale,
+                recursion_depth=self.lissa_depth,
             )
 
         # One vmapped per-example-gradient batch + one matvec replaces the

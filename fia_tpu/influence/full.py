@@ -43,6 +43,8 @@ class FullInfluenceEngine:
         lissa_scale: float = 10.0,
         lissa_depth: int = 10_000,  # reference depth, genericNeuralNet.py:544
         lissa_batch: int = 0,  # 0 = full-batch HVPs inside LiSSA
+        lissa_samples: int = 1,  # averaged recursions; >1 only reduces
+        #   variance when lissa_batch > 0 makes the HVPs stochastic
         hvp_batch: int = 0,  # 0 = one full-batch HVP program; >0 = scan
         mesh: Mesh | None = None,
     ):
@@ -54,6 +56,7 @@ class FullInfluenceEngine:
         self.lissa_scale = float(lissa_scale)
         self.lissa_depth = int(lissa_depth)
         self.lissa_batch = int(lissa_batch)
+        self.lissa_samples = int(lissa_samples)
         self.mesh = mesh
 
         # flat layout derived from HOST copies before any cross-process
@@ -228,6 +231,7 @@ class FullInfluenceEngine:
                 scale=self.lissa_scale,
                 recursion_depth=self.lissa_depth,
                 sample_hvp=sample,
+                num_samples=self.lissa_samples if self.lissa_batch else 1,
             )
         raise ValueError(f"unknown solver {self.solver!r}")
 
