@@ -59,12 +59,6 @@ echo "chainC: $(date) waiting for tunnel" >> output/chain.log
 wait_tunnel
 echo "chainC: $(date) tunnel up" >> output/chain.log
 
-run_watched "NCF full-protocol RQ1 (18k x 4)" output/rq1_ncf_ml_cal1_full.log \
-  python -m fia_tpu.cli.rq1 --dataset movielens --data_dir /root/reference/data \
-  --model NCF --num_test 2 --num_steps_train 12000 \
-  --num_steps_retrain 18000 --retrain_times 4 --batch_size 3020 \
-  --lane_chunk 16 --steps_per_dispatch 1000
-
 run_watched "RQ2 movielens MF" output/rq2_mf_ml_cal1.log \
   python -m fia_tpu.cli.rq2 --dataset movielens --data_dir /root/reference/data \
   --model MF --num_test 256 --num_steps_train 15000 --batch_size 3020
@@ -88,12 +82,18 @@ run_watched "impl A/B NCF" output/ab_impls_ncf.log \
   python scripts/ab_impls.py --rounds 4 --model NCF --train_steps 2000 \
   --out output/ab_impls_ncf.json
 
+run_watched "full bench" output/bench_r2_preview.log \
+  python bench.py --json_out output/bench_r2_preview.json
+
+run_watched "NCF full-protocol RQ1 (18k x 4)" output/rq1_ncf_ml_cal1_full.log \
+  python -m fia_tpu.cli.rq1 --dataset movielens --data_dir /root/reference/data \
+  --model NCF --num_test 2 --num_steps_train 12000 \
+  --num_steps_retrain 18000 --retrain_times 4 --batch_size 3020 \
+  --lane_chunk 16 --steps_per_dispatch 1000
+
 run_watched "Yelp MF full-protocol RQ1" output/rq1_mf_yelp_cal1.log \
   python -m fia_tpu.cli.rq1 --dataset yelp --data_dir /root/reference/data \
   --model MF --num_test 2 --num_steps_train 15000 \
   --num_steps_retrain 24000 --retrain_times 4 --batch_size 3009
-
-run_watched "full bench" output/bench_r2_preview.log \
-  python bench.py --json_out output/bench_r2_preview.json
 
 echo "chainC: $(date) done" >> output/chain.log
