@@ -74,12 +74,15 @@ def main():
     # agree across implementations on a near-converged model (the damped
     # block Hessian is then PD; far from convergence exact solves and
     # early-stopping fmin_ncg legitimately diverge).
+    # n_base: parity/baseline sample size. 16 (MF) / 8 (NCF) full-mode
+    # queries make the min-Spearman attestation statistically meaningful
+    # (VERDICT r2: 4 was a thin sample for the headline parity number).
     if QUICK:
         users, items, rows, steps, n_queries, n_base = 600, 400, 50_000, 3_000, 64, 2
         lr = 1e-2
     else:
         users, items, rows, steps, n_queries, n_base = (
-            6_040, 3_706, 975_460, 15_000, 256, 4
+            6_040, 3_706, 975_460, 15_000, 256, 16
         )
         lr = 1e-3
     k, wd, damping, batch = 16, 1e-3, 1e-6, 3020
@@ -168,15 +171,23 @@ def main():
                            damping=damping)
     ref_tight = TorchRefMFEngine(host, train.x, train.y, weight_decay=wd,
                                  damping=damping, avextol=1e-8, maxiter=2000)
+    # Baseline timing is best-of-N per query (N=3 full mode), mirroring
+    # the JAX side's repeats=3: same-day torch runs were observed 37%
+    # apart (1,672 vs 2,290 scores/s, BENCH_r02 vs the outage fallback),
+    # so a single-shot denominator put ±40% noise on vs_baseline.
+    base_reps = 1 if QUICK else 3
     base_scores_total = 0
     base_time = 0.0
     rhos = []
     res = engine.query_batch(points[:n_base])
     for t in range(n_base):
         u, i = int(points[t, 0]), int(points[t, 1])
-        t0 = time.perf_counter()
-        ref_scores, ref_rows = ref.query(u, i)
-        base_time += time.perf_counter() - t0
+        per_rep = []
+        for _ in range(base_reps):
+            t0 = time.perf_counter()
+            ref_scores, ref_rows = ref.query(u, i)
+            per_rep.append(time.perf_counter() - t0)
+        base_time += min(per_rep)
         base_scores_total += len(ref_rows)
         rhos.append(spearman(res.scores_of(t), ref_tight.query(u, i)[0]))
 
@@ -205,9 +216,10 @@ def main():
         ncf_ref = TorchRefNCFEngine(ncf_host, train.x, train.y,
                                     weight_decay=wd, damping=damping,
                                     avextol=1e-8, maxiter=2000)
-        ncf_res = ncf_engine.query_batch(points[:n_base])
+        ncf_base = min(n_base, 8)  # converged 64-dim ref solves are slow
+        ncf_res = ncf_engine.query_batch(points[:ncf_base])
         ncf_rhos = []
-        for t in range(n_base):
+        for t in range(ncf_base):
             ref_scores, _ = ncf_ref.query(int(points[t, 0]), int(points[t, 1]))
             ncf_rhos.append(spearman(ncf_res.scores_of(t), ref_scores))
         _stage(f"NCF stage done ({ncf_timing.scores_per_sec:.0f} scores/s)")
@@ -216,6 +228,8 @@ def main():
             "queries_per_sec": round(ncf_timing.queries_per_sec, 2),
             "per_query_ms": round(ncf_timing.per_query_ms, 3),
             "spearman_vs_cpu_ref_min": round(float(min(ncf_rhos)), 4),
+            "spearman_vs_cpu_ref_median": round(float(np.median(ncf_rhos)), 4),
+            "parity_queries": ncf_base,
             "train_steps": ncf_steps,
         }
     except Exception as e:  # noqa: BLE001 — report, don't lose MF results
@@ -235,7 +249,10 @@ def main():
             "num_queries": timing.num_queries,
             "num_scores": timing.num_scores,
             "cpu_ref_scores_per_sec": round(base_scores_per_sec, 1),
+            "cpu_ref_best_of": base_reps,
             "spearman_vs_cpu_ref_min": round(float(min(rhos)), 4),
+            "spearman_vs_cpu_ref_median": round(float(np.median(rhos)), 4),
+            "parity_queries": n_base,
             "train_steps": steps,
             "train_stream": stream,
             "pipelined": pipelined,
@@ -248,9 +265,13 @@ def main():
     # optional file copy of the JSON line (orchestration scripts merge
     # stdout into their watch logs); stdout stays the primary contract
     if "--json_out" in sys.argv:
-        path = sys.argv[sys.argv.index("--json_out") + 1]
-        with open(path, "w") as fh:
-            fh.write(json.dumps(out) + "\n")
+        idx = sys.argv.index("--json_out") + 1
+        if idx >= len(sys.argv):
+            print("WARNING: --json_out missing path operand; "
+                  "stdout-only", file=sys.stderr)
+        else:
+            with open(sys.argv[idx], "w") as fh:
+                fh.write(json.dumps(out) + "\n")
 
 
 if __name__ == "__main__":

@@ -80,8 +80,11 @@ def load_dataset(
                 heldout_x=cover, seed=synth_seed,
             )
             # checkpoint/model names key on this tag so calibrated-split
-            # checkpoints never collide with the older Zipf-split ones
-            train.synth_tag = "cal1"
+            # checkpoints never collide with the older Zipf-split ones.
+            # cal2 = cal1 + intra-train pair dedup + exact-fixed-point
+            # degree floor (ADVICE r2); the r2 rows measured on cal1
+            # stay labelled cal1 in BASELINE.md
+            train.synth_tag = "cal2"
         else:
             train = synthesize_ratings(
                 spec["num_users"], spec["num_items"], spec["n_train"],

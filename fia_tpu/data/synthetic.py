@@ -87,6 +87,7 @@ def fit_user_degree_profile(
     min_degree: int,
     rng,
     sigma: float = 1.0,
+    max_degree: int | None = None,
 ) -> np.ndarray:
     """Per-user train degrees under the reference's leave-4-out protocol.
 
@@ -100,25 +101,59 @@ def fit_user_degree_profile(
     magnitude/median/max shape of public MovieLens-1M user degrees),
     scaled exactly to num_rows by largest-remainder rounding and randomly
     permuted over user ids so popularity is decoupled from id order.
+
+    ``max_degree`` caps the profile from above: a real user holds each
+    item at most once, so no degree can exceed the item count (the σ=1
+    tail overshoots it at ML-1M scale — quantile 6040/6040 lands at
+    3833 > 3706 items, which would force duplicate pairs).
     """
     mean = num_rows / num_users
     if mean <= min_degree:
         raise ValueError(
             f"num_rows/num_users = {mean:.1f} <= min_degree {min_degree}"
         )
+    if max_degree is not None and mean >= max_degree:
+        raise ValueError(
+            f"num_rows/num_users = {mean:.1f} >= max_degree {max_degree}"
+        )
     from scipy.special import ndtri  # Phi^-1; scipy ships in the image
 
     mu = np.log(mean - min_degree) - 0.5 * sigma**2
     q = (np.arange(num_users) + 0.5) / num_users
     d = min_degree + np.exp(mu + sigma * ndtri(q))
-    # exact total: floor, then distribute the remainder by largest frac
-    d *= num_rows / d.sum()
-    d = np.maximum(d, min_degree)
-    d *= num_rows / d.sum()  # re-normalise after the floor clamp
+    # Exact total via two-sided waterfilling: users pinned at the floor
+    # (ceiling) take exactly min_degree (max_degree); the free users
+    # scale to consume the remaining mass. A single clamp-then-rescale
+    # pass can push clamped entries back outside the bounds (the rescale
+    # moves everything), so iterate to the fixed point — it terminates
+    # because the pinned sets only grow.
+    hi = np.inf if max_degree is None else float(max_degree)
+    lo_pin = np.zeros(num_users, bool)
+    hi_pin = np.zeros(num_users, bool)
+    while True:
+        free = ~(lo_pin | hi_pin)
+        if not free.any():
+            raise ValueError("degree profile infeasible")
+        # hi is inf when uncapped: inf * 0 = NaN, so the ceiling mass
+        # must short-circuit while the hi_pin set is empty
+        hi_mass = hi * hi_pin.sum() if hi_pin.any() else 0.0
+        mass = num_rows - min_degree * lo_pin.sum() - hi_mass
+        scale = mass / d[free].sum()
+        new_lo = free & (d * scale < min_degree)
+        new_hi = free & (d * scale > hi)
+        if not (new_lo.any() or new_hi.any()):
+            d = np.where(free, d * scale, np.where(lo_pin, float(min_degree), hi))
+            break
+        lo_pin |= new_lo
+        hi_pin |= new_hi
     base = np.floor(d).astype(np.int64)
     short = num_rows - base.sum()
     order = np.argsort(d - base)[::-1]
     base[order[:short]] += 1
+    if base.min() < min_degree or base.sum() != num_rows or (
+        max_degree is not None and base.max() > max_degree
+    ):
+        raise AssertionError("degree profile violated its invariants")
     return base[rng.permutation(num_users)]
 
 
@@ -139,9 +174,11 @@ def synthesize_calibrated(
     items unseen in the 4-per-user holdout keep mass); user degrees come
     from :func:`fit_user_degree_profile`. Train pairs are kept disjoint
     from the heldout pairs (as the reference's real splits are — they
-    were literally held out of train), and every heldout item is
-    guaranteed at least one train row so FIA queries have non-empty
-    related sets on both sides.
+    were literally held out of train) AND unique among themselves (the
+    real splits are sets of distinct (u, i) pairs; a duplicate would
+    double-count its row in related sets and Hessians), and every
+    heldout item is guaranteed at least one train row so FIA queries
+    have non-empty related sets on both sides.
     """
     rng = np.random.default_rng(seed)
     heldout_x = np.asarray(heldout_x)
@@ -149,23 +186,56 @@ def synthesize_calibrated(
     p_item = ic + 0.5
     p_item /= p_item.sum()
 
-    degrees = fit_user_degree_profile(num_users, num_rows, min_degree, rng)
+    # cap degrees at num_items - 8: a user holds each item at most once,
+    # and ~4 items per user live in the heldout split (leave-4-out), so
+    # the cap leaves slack for the disjointness constraint
+    degrees = fit_user_degree_profile(
+        num_users, num_rows, min_degree, rng, max_degree=num_items - 8
+    )
     users = np.repeat(np.arange(num_users, dtype=np.int64), degrees)
     items = rng.choice(num_items, size=num_rows, p=p_item)
 
-    # resample collisions with heldout pairs (the reference train never
-    # contains them); a handful of rounds clears the few-per-mille hits
+    # Resample collisions with heldout pairs (the reference train never
+    # contains them) and intra-train duplicates (the real splits hold
+    # distinct pairs) in one loop; a handful of rounds clears the
+    # few-per-mille hits. High-degree users on a skewed item marginal can
+    # re-collide with their own rows indefinitely, so stubborn rows fall
+    # through to an exact per-user weighted draw WITHOUT replacement
+    # (Gumbel top-k over the items the user doesn't already hold).
     held_codes = np.unique(
         heldout_x[:, 0].astype(np.int64) * num_items + heldout_x[:, 1]
     )
-    for _ in range(64):
+
+    def _bad_mask():
         codes = users * num_items + items
-        bad = np.isin(codes, held_codes)
+        order = np.argsort(codes, kind="stable")
+        sc = codes[order]
+        dup = np.zeros(num_rows, bool)
+        # all-but-first occurrence of each duplicated code
+        dup[order[1:]] = sc[1:] == sc[:-1]
+        return np.isin(codes, held_codes) | dup
+
+    for _ in range(16):
+        bad = _bad_mask()
         if not bad.any():
             break
         items[bad] = rng.choice(num_items, size=int(bad.sum()), p=p_item)
-    else:
-        raise RuntimeError("could not decollide train pairs from heldout")
+    bad = _bad_mask()
+    if bad.any():
+        log_p = np.log(p_item)
+        for u in np.unique(users[bad]):
+            mine = users == u
+            rows = np.flatnonzero(mine & bad)
+            g = log_p + rng.gumbel(size=num_items)
+            g[items[mine & ~bad]] = -np.inf  # items the user already holds
+            lo = np.searchsorted(held_codes, u * num_items)
+            hi = np.searchsorted(held_codes, (u + 1) * num_items)
+            g[held_codes[lo:hi] - u * num_items] = -np.inf
+            if np.isfinite(g).sum() < len(rows):
+                raise RuntimeError("user degree exceeds available items")
+            items[rows] = np.argpartition(-g, len(rows))[: len(rows)]
+    if _bad_mask().any():
+        raise RuntimeError("could not decollide train pairs")
 
     # cover heldout items that drew zero rows: overwrite the item of one
     # random row each (user degrees untouched). A live per-item count
@@ -174,6 +244,15 @@ def synthesize_calibrated(
     live = np.bincount(items, minlength=num_items)
     need = np.flatnonzero((ic > 0) & (live == 0))
     if len(need):
+        train_codes = np.sort(users * num_items + items)
+        new_codes: set[int] = set()
+
+        def _in_train(code: int) -> bool:
+            j = np.searchsorted(train_codes, code)
+            return (j < len(train_codes) and train_codes[j] == code) or (
+                code in new_codes
+            )
+
         cand = rng.permutation(num_rows)
         ci = 0
         for it in need:
@@ -184,10 +263,15 @@ def synthesize_calibrated(
                     continue  # sole remaining row of its item
                 code = users[r] * num_items + int(it)
                 j = np.searchsorted(held_codes, code)
-                if j == len(held_codes) or held_codes[j] != code:
+                # the donor row must not collide with heldout NOR
+                # duplicate an existing (u, it) train pair
+                if (
+                    j == len(held_codes) or held_codes[j] != code
+                ) and not _in_train(code):
                     live[items[r]] -= 1
                     items[r] = it
                     live[it] += 1
+                    new_codes.add(code)
                     break
             else:
                 raise RuntimeError("could not cover heldout items")

@@ -360,7 +360,14 @@ class InfluenceEngine:
             # _query_flat rounded S up to a device multiple; the chunk
             # must divide the PER-DEVICE shard, not just S
             ndev = mesh.shape["data"]
-            assert s_pad % ndev == 0, (s_pad, ndev)
+            # explicit raise, not assert: this is trace-time (cost nil)
+            # and a caller bypassing _dispatch_flat's rounding under
+            # python -O would otherwise get a wrong reshape, not an error
+            if s_pad % ndev != 0:
+                raise ValueError(
+                    f"padded size {s_pad} not divisible by mesh devices "
+                    f"{ndev}; route through _dispatch_flat"
+                )
             chunk = math.gcd(s_pad // ndev, self.flat_chunk)
 
             def c(a):  # shard an S-leading array across 'data'

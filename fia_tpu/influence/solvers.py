@@ -28,6 +28,7 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 
@@ -156,6 +157,7 @@ def solve_lissa(
     recursion_depth: int = 1000,
     num_samples: int = 1,
     sample_hvp: Callable[[int, jnp.ndarray], jnp.ndarray] | None = None,
+    auto_scale: bool = True,
 ) -> jnp.ndarray:
     """LiSSA inverse-HVP estimate.
 
@@ -164,7 +166,36 @@ def solve_lissa(
     ``genericNeuralNet.py:524-533``); otherwise the deterministic ``hvp``
     is used every step. Defaults mirror the reference: scale 10, LiSSA
     damping 0 (the Hessian damping lives inside ``hvp``).
+
+    The recursion only converges when λ_max(H) < 2·scale; the reference
+    silently NaNs past that (observed: NCF blocks whose GMF cross term
+    pushes λ_max past 20 at the default scale 10). ``auto_scale`` keeps
+    the reference semantics whenever they are valid — the estimator's
+    fixed point is (H/scale)⁻¹·v/scale = H⁻¹v for EVERY valid scale, so
+    raising it never changes the answer — by estimating λ_max with a
+    32-step power iteration (cost: 32 extra HVPs against a 10k-deep
+    recursion) and lifting scale to 1.05·λ_max only where the
+    configured value would diverge.
     """
+    if auto_scale:
+        # estimate on the DETERMINISTIC hvp even in the minibatched
+        # variant — a single minibatch's λ_max says nothing about the
+        # batch the recursion will step on next; the full-data estimate
+        # is representative, and the stochastic case takes a wider
+        # margin to cover batch-to-batch curvature spread
+        nv = jnp.linalg.norm(v)
+        w0 = jnp.where(nv > 0, v / jnp.maximum(nv, 1e-30),
+                       jnp.ones_like(v) / np.sqrt(v.size))
+
+        def pit(_, st):
+            w, _ = st
+            hw = hvp(w)
+            lam = jnp.linalg.norm(hw)
+            return hw / jnp.maximum(lam, 1e-30), lam
+
+        _, lam = lax.fori_loop(0, 32, pit, (w0, jnp.zeros(())))
+        margin = 1.05 if sample_hvp is None else 1.5
+        scale = jnp.maximum(scale, margin * lam)
 
     def one_sample(i, acc):
         def body(j, cur):

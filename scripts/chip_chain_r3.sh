@@ -1,0 +1,135 @@
+#!/bin/bash
+# Round-3 chip chain: waits for the TPU tunnel, then runs every queued
+# chip job sequentially, ordered by value-per-chip-minute (quick
+# headline re-measures first, multi-hour fidelity protocols last) so a
+# mid-chain outage still banks the most important rows. Supersedes
+# chip_chain_r2c.sh (same jobs + the r3 additions: pipelined A/B,
+# decompose scaling, mid-budget NCF point, embed sweep, full-space
+# stress). Each job runs under a stall watchdog: if its log stops
+# growing for STALL_S seconds (a wedged tunnel client blocks forever,
+# observed r2), the job is killed, the tunnel re-probed, and the job
+# retried once.
+set -u
+cd "$(dirname "$0")/.."
+STALL_S=${STALL_S:-1500}
+
+wait_tunnel() {
+  until timeout 60 python -c \
+    "import jax, jax.numpy as jnp; jnp.ones(()).block_until_ready()" \
+    >/dev/null 2>&1; do
+    sleep 60
+  done
+}
+
+run_watched() {  # run_watched <name> <logfile> <cmd...>
+  local name="$1" log="$2"; shift 2
+  local attempt
+  for attempt in 1 2; do
+    echo "chainR3: $(date) $name (attempt $attempt)" >> output/chain.log
+    "$@" > "$log" 2>&1 &
+    local pid=$!
+    local last_size=-1 stalled=0
+    while kill -0 "$pid" 2>/dev/null; do
+      sleep 60
+      local size
+      size=$(stat -c %s "$log" 2>/dev/null || echo 0)
+      if [ "$size" -eq "$last_size" ]; then
+        stalled=$((stalled + 60))
+      else
+        stalled=0
+        last_size=$size
+      fi
+      if [ "$stalled" -ge "$STALL_S" ]; then
+        echo "chainR3: $(date) $name STALLED (${STALL_S}s no log growth); killing" >> output/chain.log
+        kill "$pid" 2>/dev/null
+        sleep 5
+        kill -9 "$pid" 2>/dev/null
+        break
+      fi
+    done
+    wait "$pid" 2>/dev/null
+    local rc=$?
+    if [ "$stalled" -lt "$STALL_S" ] && [ "$rc" -eq 0 ]; then
+      echo "chainR3: $(date) $name ok" >> output/chain.log
+      return 0
+    fi
+    echo "chainR3: $(date) $name failed (rc=$rc); re-probing tunnel" >> output/chain.log
+    wait_tunnel
+  done
+  echo "chainR3: $(date) $name GAVE UP after 2 attempts" >> output/chain.log
+  return 1
+}
+
+echo "chainR3: $(date) waiting for tunnel" >> output/chain.log
+wait_tunnel
+echo "chainR3: $(date) tunnel up" >> output/chain.log
+
+# --- tier 1: headline chip numbers (bench + A/Bs) ---------------------
+run_watched "full bench (r3 preview)" output/bench_r3_preview.log \
+  python bench.py --json_out output/bench_r3_preview.json
+
+run_watched "impl A/B MF (+pipeline)" output/ab_impls_mf.log \
+  python scripts/ab_impls.py --rounds 6 --breakdown --pipeline \
+  --out output/ab_impls_mf.json
+
+run_watched "impl A/B NCF (+pipeline)" output/ab_impls_ncf.log \
+  python scripts/ab_impls.py --rounds 4 --model NCF --train_steps 2000 \
+  --pipeline --out output/ab_impls_ncf.json
+
+# --- tier 2: RQ2 re-measures on the calibrated stream -----------------
+run_watched "RQ2 movielens MF" output/rq2_mf_ml_cal2.log \
+  python -m fia_tpu.cli.rq2 --dataset movielens --data_dir /root/reference/data \
+  --model MF --num_test 256 --num_steps_train 15000 --batch_size 3020
+
+run_watched "RQ2 movielens NCF" output/rq2_ncf_ml_cal2.log \
+  python -m fia_tpu.cli.rq2 --dataset movielens --data_dir /root/reference/data \
+  --model NCF --num_test 256 --num_steps_train 12000 --batch_size 3020
+
+run_watched "RQ2 yelp MF" output/rq2_mf_yelp_cal2.log \
+  python -m fia_tpu.cli.rq2 --dataset yelp --data_dir /root/reference/data \
+  --model MF --num_test 256 --num_steps_train 15000 --batch_size 3009
+
+run_watched "RQ2 yelp NCF" output/rq2_ncf_yelp_cal2.log \
+  python -m fia_tpu.cli.rq2 --dataset yelp --data_dir /root/reference/data \
+  --model NCF --num_test 256 --num_steps_train 12000 --batch_size 3009
+
+run_watched "RQ2 embed sweep" output/rq2_embed_sweep.log \
+  bash scripts/RQ2.sh
+
+# --- tier 3: decompose scaling (VERDICT r2 item 3) --------------------
+run_watched "decompose 300k" output/decompose_ncf_300k.log \
+  python scripts/decompose.py --rows 300000 --num_test 3 --no_retrain
+run_watched "decompose 600k" output/decompose_ncf_600k.log \
+  python scripts/decompose.py --rows 600000 --num_test 3 --no_retrain
+run_watched "decompose 975k" output/decompose_ncf_975k.log \
+  python scripts/decompose.py --rows 975460 --num_test 3 --no_retrain
+
+# --- tier 4: full-protocol fidelity (multi-hour each) -----------------
+run_watched "NCF mid-budget RQ1 (6k x 3)" output/rq1_ncf_ml_cal2_mid.log \
+  python -m fia_tpu.cli.rq1 --dataset movielens --data_dir /root/reference/data \
+  --model NCF --num_test 2 --num_steps_train 12000 \
+  --num_steps_retrain 6000 --retrain_times 3 --batch_size 3020 \
+  --lane_chunk 16 --steps_per_dispatch 1000
+
+run_watched "NCF full-protocol RQ1 (18k x 4)" output/rq1_ncf_ml_cal2_full.log \
+  python -m fia_tpu.cli.rq1 --dataset movielens --data_dir /root/reference/data \
+  --model NCF --num_test 2 --num_steps_train 12000 \
+  --num_steps_retrain 18000 --retrain_times 4 --batch_size 3020 \
+  --lane_chunk 16 --steps_per_dispatch 1000
+
+run_watched "Yelp MF full-protocol RQ1" output/rq1_mf_yelp_cal2.log \
+  python -m fia_tpu.cli.rq1 --dataset yelp --data_dir /root/reference/data \
+  --model MF --num_test 2 --num_steps_train 15000 \
+  --num_steps_retrain 24000 --retrain_times 4 --batch_size 3009
+
+run_watched "Yelp NCF full-protocol RQ1 (18k x 4)" output/rq1_ncf_yelp_cal2_full.log \
+  python -m fia_tpu.cli.rq1 --dataset yelp --data_dir /root/reference/data \
+  --model NCF --num_test 2 --num_steps_train 12000 \
+  --num_steps_retrain 18000 --retrain_times 4 --batch_size 3009 \
+  --lane_chunk 16 --steps_per_dispatch 1000
+
+# --- tier 5: full-space stress row ------------------------------------
+run_watched "stress full-space" output/stress_full_space.log \
+  python scripts/stress.py --full_space --num_queries 64
+
+echo "chainR3: $(date) done" >> output/chain.log

@@ -61,6 +61,11 @@ def main():
     ap.add_argument("--retrain_times", type=int, default=3)
     ap.add_argument("--num_to_remove", type=int, default=50)
     ap.add_argument("--lane_chunk", type=int, default=16)
+    ap.add_argument("--no_retrain", action="store_true",
+                    help="skip the LOO ground truth: record only "
+                    "r(block, full) — the cheap pair, which is what the "
+                    "related-set-size scaling question needs (VERDICT r2 "
+                    "item 3; retraining adds nothing to that comparison)")
     ap.add_argument("--data_dir", type=str, default="/root/reference/data")
     ap.add_argument("--seed", type=int, default=17)
     args = ap.parse_args()
@@ -138,6 +143,16 @@ def main():
               f"(oracle solve {solve_s:.0f}s, {len(related)} related rows)",
               file=sys.stderr, flush=True)
 
+        if args.no_retrain:
+            results.append({
+                "test_idx": t,
+                "related": int(len(related)),
+                "r_block_full": float(r_bf),
+                "rs_block_full": float(spearman(block_scores, full_scores)),
+                "oracle_solve_s": round(solve_s, 1),
+            })
+            continue
+
         rt = test_retraining(
             engine, train, test, t,
             num_to_remove=args.num_to_remove,
@@ -173,11 +188,12 @@ def main():
         "per_test": results,
         "mean_r_block_full": round(
             float(np.mean([e["r_block_full"] for e in results])), 4),
-        "mean_r_block_actual": round(
-            float(np.mean([e["r_block_actual"] for e in results])), 4),
-        "mean_r_full_actual": round(
-            float(np.mean([e["r_full_actual"] for e in results])), 4),
     }
+    if not args.no_retrain:
+        out["mean_r_block_actual"] = round(
+            float(np.mean([e["r_block_actual"] for e in results])), 4)
+        out["mean_r_full_actual"] = round(
+            float(np.mean([e["r_full_actual"] for e in results])), 4)
     print(json.dumps(out))
 
 

@@ -8,7 +8,14 @@ Runs the same influence query batch through the direct (LU), CG
 pairwise score correlations. The FIA block system is a damped 34-dim PD
 solve, so all three should agree to high precision when converged.
 
+The MF block is the easy 34-dim system; ``--model NCF`` exercises the
+harder 64-dim block with the GMF bilinear cross term, and ``--dataset
+yelp`` the sparse-marginal regime (VERDICT r2 weak item 3 asked for
+both before trusting the avextol -> cg_tol = 1e-6*avextol mapping
+beyond MF).
+
 Usage: python scripts/solver_agreement.py [--smoke] [--model MF]
+       [--dataset yelp]
 """
 
 import argparse
@@ -36,6 +43,8 @@ def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--smoke", action="store_true")
     ap.add_argument("--model", default="MF", choices=["MF", "NCF"])
+    ap.add_argument("--dataset", default="movielens",
+                    choices=["movielens", "yelp"])
     ap.add_argument("--num_test", type=int, default=64)
     ap.add_argument("--train_steps", type=int, default=15_000)
     ap.add_argument("--lissa_depth", type=int, default=10_000)
@@ -59,8 +68,11 @@ def main():
     else:
         from fia_tpu.data.loaders import load_dataset
 
-        splits = load_dataset("movielens", args.data_dir)
-        users, items, batch = 6_040, 3_706, 3_020
+        splits = load_dataset(args.dataset, args.data_dir)
+        if args.dataset == "movielens":
+            users, items, batch = 6_040, 3_706, 3_020
+        else:
+            users, items, batch = 25_677, 25_815, 3_009
     train, test = splits["train"], splits["test"]
 
     model = MODELS[args.model](users, items, 16, 1e-3)
@@ -91,7 +103,8 @@ def main():
         scores[name] = [res.scores_of(t) for t in range(len(points))]
         print(f"solver_agreement: {name} done", file=sys.stderr, flush=True)
 
-    out = {"model": args.model, "num_test": args.num_test,
+    out = {"model": args.model, "dataset": args.dataset,
+           "num_test": args.num_test,
            "lissa_depth": args.lissa_depth, "train_steps": args.train_steps}
     for a, b in (("direct", "cg"), ("direct", "lissa"), ("cg", "lissa")):
         rs = [pearson(x, y) for x, y in zip(scores[a], scores[b])

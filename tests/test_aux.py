@@ -108,7 +108,7 @@ class TestReferenceData:
 
         splits = load_movielens(REF_DATA)
         tr = splits["train"]
-        assert getattr(tr, "synth_tag", "") == "cal1"
+        assert getattr(tr, "synth_tag", "") == "cal2"
         hx = np.concatenate([splits["validation"].x, splits["test"].x])
         ni = 3_706
         uc = np.bincount(tr.x[:, 0], minlength=6_040)
@@ -129,6 +129,10 @@ class TestReferenceData:
         assert not np.isin(codes_t, codes_h).any()
         assert not ((hic > 0) & (ic == 0)).any()
         assert (uc == 0).sum() == 0
+        # cal2 invariants (ADVICE r2): pairs are distinct, as in the real
+        # splits, and no degree exceeds what distinct items allow
+        assert len(np.unique(codes_t)) == len(codes_t)
+        assert uc.max() <= ni - 8
 
     def test_calibrated_yelp_coverage_and_disjointness(self):
         """Yelp's sparse item marginals (many 1-row items) are the regime
@@ -138,7 +142,7 @@ class TestReferenceData:
 
         splits = load_yelp(REF_DATA)
         tr = splits["train"]
-        assert getattr(tr, "synth_tag", "") == "cal1"
+        assert getattr(tr, "synth_tag", "") == "cal2"
         hx = np.concatenate([splits["validation"].x, splits["test"].x])
         ni = 25_815
         ic = np.bincount(tr.x[:, 1], minlength=ni)
@@ -148,6 +152,7 @@ class TestReferenceData:
         codes_t = tr.x[:, 0].astype(np.int64) * ni + tr.x[:, 1]
         codes_h = np.unique(hx[:, 0].astype(np.int64) * ni + hx[:, 1])
         assert not np.isin(codes_t, codes_h).any()
+        assert len(np.unique(codes_t)) == len(codes_t)  # cal2: distinct pairs
 
     def test_calibrate_false_keeps_zipf_stream(self):
         """The round-1 Zipf stream stays reproducible for comparison."""

@@ -274,6 +274,29 @@ class TestSolvers:
                                 recursion_depth=3000)
         np.testing.assert_allclose(H @ x, v, rtol=1e-3, atol=1e-3)
 
+    def test_lissa_auto_scale_rescues_divergent_blocks(self):
+        """λ_max = 30 > 2·scale at the reference scale 10: the raw
+        recursion diverges to non-finite values (the reference's
+        behavior — observed on NCF blocks whose GMF cross term pushes
+        λ_max past 20), while the power-iteration safeguard lifts the
+        scale per query and still converges to H⁻¹v."""
+        d = 6
+        H = jnp.eye(d) * jnp.linspace(0.5, 30.0, d)
+        v = jnp.ones(d)
+        raw = solvers.solve_lissa(lambda w: H @ w, v, scale=10.0,
+                                  recursion_depth=2000, auto_scale=False)
+        assert not np.isfinite(np.asarray(raw)).all()
+        x = solvers.solve_lissa(lambda w: H @ w, v, scale=10.0,
+                                recursion_depth=2000)
+        np.testing.assert_allclose(H @ x, v, rtol=1e-3, atol=1e-3)
+        # valid configured scales keep their reference semantics: the
+        # safeguard must not perturb a convergent recursion's result
+        ok = solvers.solve_lissa(lambda w: H @ w, v, scale=31.0,
+                                 recursion_depth=4000)
+        ok_raw = solvers.solve_lissa(lambda w: H @ w, v, scale=31.0,
+                                     recursion_depth=4000, auto_scale=False)
+        np.testing.assert_allclose(ok, ok_raw, rtol=1e-6, atol=1e-8)
+
 
 @pytest.mark.parametrize("model_cls", [MF, NCF])
 class TestEngine:
