@@ -31,8 +31,14 @@ def save(path: str, params, opt_state=None, step: int = 0) -> str:
         payload.update({f"o{i}": np.asarray(l) for i, l in enumerate(oleaves)})
         payload["__otree__"] = np.array(otreedef)
     payload["__step__"] = np.array(step)
-    np.savez(path if path.endswith(".npz") else path + ".npz", **payload)
-    return path if path.endswith(".npz") else path + ".npz"
+    out = path if path.endswith(".npz") else path + ".npz"
+    # write-to-temp + atomic rename: a concurrent reader (e.g. a chip
+    # experiment loading a checkpoint another backend's run is just
+    # rewriting) must never see a half-written zip
+    tmp = f"{out[:-4]}.tmp.{os.getpid()}.npz"  # np.savez appends .npz itself
+    np.savez(tmp, **payload)
+    os.replace(tmp, out)
+    return out
 
 
 def load(path: str, params_template, opt_template=None):

@@ -105,6 +105,11 @@ run_watched "decompose 975k" output/decompose_ncf_975k.log \
   python scripts/decompose.py --rows 975460 --num_test 3 --no_retrain
 
 # --- tier 4: full-protocol fidelity (multi-hour each) -----------------
+run_watched "MF ML-1M full-protocol RQ1 (24k x 4)" output/rq1_mf_ml_cal2_full.log \
+  python -m fia_tpu.cli.rq1 --dataset movielens --data_dir /root/reference/data \
+  --model MF --num_test 2 --num_steps_train 15000 \
+  --num_steps_retrain 24000 --retrain_times 4 --batch_size 3020
+
 run_watched "NCF mid-budget RQ1 (6k x 3)" output/rq1_ncf_ml_cal2_mid.log \
   python -m fia_tpu.cli.rq1 --dataset movielens --data_dir /root/reference/data \
   --model NCF --num_test 2 --num_steps_train 12000 \

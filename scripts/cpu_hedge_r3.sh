@@ -1,0 +1,63 @@
+#!/bin/bash
+# Round-3 CPU hedge: insurance against the TPU tunnel staying down (it
+# was down 10+ h at round start). Runs the measurement jobs that are
+# numerically backend-independent — decompose scaling (block-vs-full
+# correlation) and early-plateau-budget fidelity rows on the cal2
+# stream — on the XLA CPU backend, sequentially, after any running
+# solver-agreement jobs drain. Chip-chain rows supersede these where
+# both exist; fidelity/agreement numbers are backend-independent, so a
+# CPU row is a valid (if slower-to-produce) measurement.
+set -u
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS=cpu
+# own artifact/checkpoint namespace: the chip chain writes the same
+# RQ1-<model>-<dataset>.npz and checkpoint filenames under output/, and
+# the slower CPU row must never clobber a multi-hour chip artifact
+# (nor race its checkpoint loads)
+HDIR=output/cpu_hedge
+mkdir -p "$HDIR"
+
+log() { echo "cpu_hedge: $(date) $*" >> output/chain.log; }
+
+# wait for the solver-agreement chain to drain (shares the CPU)
+while pgrep -f "solver_agreement.py" > /dev/null; do sleep 60; done
+
+log "start"
+
+run() {  # run <name> <logfile> <cmd...>
+  local name="$1" logf="$2"; shift 2
+  log "$name"
+  if "$@" > "$logf" 2>&1; then log "$name ok"; else log "$name FAILED"; fi
+}
+
+run "decompose 300k (cpu)" output/decompose_ncf_300k_cpu.log \
+  python scripts/decompose.py --rows 300000 --num_test 3 --no_retrain
+run "decompose 600k (cpu)" output/decompose_ncf_600k_cpu.log \
+  python scripts/decompose.py --rows 600000 --num_test 3 --no_retrain
+run "decompose 975k (cpu)" output/decompose_ncf_975k_cpu.log \
+  python scripts/decompose.py --rows 975460 --num_test 3 --no_retrain
+
+# early-plateau-budget fidelity rows on cal2 (the stream the r2 2k-by-2
+# cal1 rows no longer describe)
+run "RQ1 MF ml cal2 2kx2 (cpu)" output/rq1_mf_ml_cal2_2k2_cpu.log \
+  python -m fia_tpu.cli.rq1 --backend cpu --dataset movielens \
+  --data_dir /root/reference/data --model MF --num_test 2 \
+  --num_steps_train 15000 --num_steps_retrain 2000 --retrain_times 2 \
+  --num_to_remove 30 --batch_size 3020 --lane_chunk 16 --train_dir "$HDIR"
+run "RQ1 NCF ml cal2 2kx2 (cpu)" output/rq1_ncf_ml_cal2_2k2_cpu.log \
+  python -m fia_tpu.cli.rq1 --backend cpu --dataset movielens \
+  --data_dir /root/reference/data --model NCF --num_test 2 \
+  --num_steps_train 12000 --num_steps_retrain 2000 --retrain_times 2 \
+  --num_to_remove 30 --batch_size 3020 --lane_chunk 16 --train_dir "$HDIR"
+run "RQ1 MF yelp cal2 2kx2 (cpu)" output/rq1_mf_yelp_cal2_2k2_cpu.log \
+  python -m fia_tpu.cli.rq1 --backend cpu --dataset yelp \
+  --data_dir /root/reference/data --model MF --num_test 2 \
+  --num_steps_train 15000 --num_steps_retrain 2000 --retrain_times 2 \
+  --num_to_remove 30 --batch_size 3009 --lane_chunk 16 --train_dir "$HDIR"
+run "RQ1 NCF yelp cal2 2kx2 (cpu)" output/rq1_ncf_yelp_cal2_2k2_cpu.log \
+  python -m fia_tpu.cli.rq1 --backend cpu --dataset yelp \
+  --data_dir /root/reference/data --model NCF --num_test 2 \
+  --num_steps_train 12000 --num_steps_retrain 2000 --retrain_times 2 \
+  --num_to_remove 30 --batch_size 3009 --lane_chunk 16 --train_dir "$HDIR"
+
+log "done"

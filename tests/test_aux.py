@@ -154,6 +154,23 @@ class TestReferenceData:
         assert not np.isin(codes_t, codes_h).any()
         assert len(np.unique(codes_t)) == len(codes_t)  # cal2: distinct pairs
 
+    def test_degree_profile_invariants(self):
+        """Two-sided waterfilling: exact total, floor respected with and
+        without a ceiling, and the uncapped default path (hi = inf) must
+        not poison the mass bookkeeping (inf·0 = NaN regression)."""
+        from fia_tpu.data.synthetic import fit_user_degree_profile
+
+        rng = np.random.default_rng(0)
+        d = fit_user_degree_profile(100, 5_000, 16, rng)  # uncapped
+        assert d.sum() == 5_000 and d.min() >= 16
+        d = fit_user_degree_profile(6_040, 975_460, 16, rng,
+                                    max_degree=3_698)
+        assert d.sum() == 975_460 and d.min() >= 16 and d.max() <= 3_698
+        with np.testing.assert_raises(ValueError):
+            fit_user_degree_profile(10, 50, 16, rng)  # mean <= floor
+        with np.testing.assert_raises(ValueError):
+            fit_user_degree_profile(10, 500, 16, rng, max_degree=40)
+
     def test_calibrate_false_keeps_zipf_stream(self):
         """The round-1 Zipf stream stays reproducible for comparison."""
         from fia_tpu.data.loaders import load_dataset
