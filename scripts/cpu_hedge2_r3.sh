@@ -1,0 +1,39 @@
+#!/bin/bash
+# Round-3 CPU hedge, phase 2: the longer fidelity protocols, in case
+# the tunnel outage lasts the whole round. Starts after phase 1
+# (cpu_hedge_r3.sh) drains. Chip rows supersede these if the tunnel
+# returns; fidelity numerics are backend-independent.
+set -u
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS=cpu
+HDIR=output/cpu_hedge
+mkdir -p "$HDIR"
+
+log() { echo "cpu_hedge2: $(date) $*" >> output/chain.log; }
+
+while pgrep -f "cpu_hedge_r3.sh" > /dev/null; do sleep 120; done
+log "start"
+
+run() {
+  local name="$1" logf="$2"; shift 2
+  log "$name"
+  if "$@" > "$logf" 2>&1; then log "$name ok"; else log "$name FAILED"; fi
+}
+
+# mid-budget NCF point on the calibrated stream (VERDICT item 2's
+# plateau-on-the-right-stream measurement)
+run "RQ1 NCF ml cal2 6kx3 (cpu)" output/rq1_ncf_ml_cal2_6k3_cpu.log \
+  python -m fia_tpu.cli.rq1 --backend cpu --dataset movielens \
+  --data_dir /root/reference/data --model NCF --num_test 2 \
+  --num_steps_train 12000 --num_steps_retrain 6000 --retrain_times 3 \
+  --batch_size 3020 --lane_chunk 16 --steps_per_dispatch 1000 \
+  --train_dir "$HDIR"
+
+# the headline fidelity row at the reference's full protocol
+run "RQ1 MF ml cal2 24kx4 (cpu)" output/rq1_mf_ml_cal2_full_cpu.log \
+  python -m fia_tpu.cli.rq1 --backend cpu --dataset movielens \
+  --data_dir /root/reference/data --model MF --num_test 2 \
+  --num_steps_train 15000 --num_steps_retrain 24000 --retrain_times 4 \
+  --batch_size 3020 --train_dir "$HDIR"
+
+log "done"
