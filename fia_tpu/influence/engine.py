@@ -112,6 +112,58 @@ class InfluenceResult:
         return self.related_idx[t, : self.counts[t]]
 
 
+def _is_device_oom(e: Exception) -> bool:
+    """Was this dispatch/compile failure plausibly device-memory exhaustion?
+
+    Local backends raise RESOURCE_EXHAUSTED / "Ran out of memory" in the
+    exception text. Tunnel-attached TPUs (axon remote compile) wrap the
+    XLA error in a generic "HTTP 500: tpu_compile_helper subprocess exit
+    code N" whose OOM detail only reaches stderr — treat those as
+    possibly-OOM too: the adaptive retry halves the batch at most
+    log2(T) times and re-raises at chunk=1, so misclassifying a genuine
+    compile bug costs bounded retries, while missing an OOM kills a
+    multi-hour run (observed: 256-query NCF batch at pad 4608, 16.06G of
+    15.75G HBM).
+    """
+    s = str(e)
+    return (
+        "RESOURCE_EXHAUSTED" in s
+        or "out of memory" in s.lower()
+        or "tpu_compile_helper subprocess exit code" in s
+    )
+
+
+def _concat_results(parts: list["InfluenceResult"]) -> "InfluenceResult":
+    """Stitch same-pad chunked query results back into one batch result.
+
+    Valid only for chunks of one logical batch dispatched at a common
+    pad (the adaptive path guarantees this): packed scores concatenate
+    in query order because per-query postings are contiguous, and the
+    dense (T, P) views share a width.
+    """
+    counts = np.concatenate([p.counts for p in parts])
+    ihvp = np.concatenate([p.ihvp for p in parts])
+    test_grad = np.concatenate([p.test_grad for p in parts])
+    if parts[0]._packed is not None:
+        return InfluenceResult(
+            counts=counts,
+            ihvp=ihvp,
+            test_grad=test_grad,
+            packed=np.concatenate([p._packed for p in parts]),
+            test_points=np.concatenate([p._test_points for p in parts]),
+            index=parts[0]._index,
+            pad=max(p._pad for p in parts),
+        )
+    return InfluenceResult(
+        np.concatenate([p.scores for p in parts]),
+        np.concatenate([p.related_idx for p in parts]),
+        np.concatenate([p.related_mask for p in parts]),
+        counts,
+        ihvp,
+        test_grad,
+    )
+
+
 class InfluenceEngine:
     """Block-restricted (FIA) influence over a trained model.
 
@@ -253,6 +305,13 @@ class InfluenceEngine:
         # a power of two so it always divides the power-of-two S pad.
         self.flat_chunk = 1 << max(0, int(flat_chunk).bit_length() - 1)
         self._jitted = {}  # pad length -> compiled batched query
+        # Memory-adaptive padded-path state (_query_padded_adaptive):
+        # the largest (queries x pad) cell count that dispatched
+        # successfully, and the smallest that exhausted device memory.
+        # Shared across pads — the dominant temporaries scale with
+        # T x pad x block_dim, so cells transfer between pad buckets.
+        self._cells_ok = 0
+        self._cells_bad = 1 << 62
 
     # -- the pure per-test-point query ------------------------------------
     def _query_one(self, params, train_x, train_y, postings, u, i, test_x,
@@ -678,7 +737,7 @@ class InfluenceEngine:
                 ihvp = test_grad = None
                 for p in uniq:
                     sel = np.flatnonzero(pads == p)
-                    r = self._query_padded(test_points[sel], int(p))
+                    r = self._query_padded_adaptive(test_points[sel], int(p))
                     if ihvp is None:
                         d = r.ihvp.shape[1]
                         ihvp = np.zeros((T, d), np.float32)
@@ -692,7 +751,80 @@ class InfluenceEngine:
                     test_grad[sel] = r.test_grad
                 return InfluenceResult(scores, rel_idx, rel_mask,
                                        out_counts, ihvp, test_grad)
-        return self._query_padded(test_points, pad_to)
+        return self._query_padded_adaptive(test_points, pad_to)
+
+    def _query_padded_adaptive(
+        self, test_points: np.ndarray, pad_to: int | None
+    ) -> InfluenceResult:
+        """Dispatch a padded query batch, splitting it when HBM runs out.
+
+        A (T, pad) padded program's temporaries scale with T x pad x
+        block_dim; big NCF batches can exceed a 16G chip (a 256-query
+        batch at pad 4608 needed 16.06G). On a memory failure the batch
+        is re-dispatched in halved query chunks at the SAME pad (so
+        chunks share one compiled program and concatenate exactly);
+        the working/failing cell counts persist on the engine, so later
+        batches — including other pad buckets — pre-chunk instead of
+        repeating the failing compile.
+        """
+        test_points = np.asarray(test_points)
+        T = test_points.shape[0]
+        counts = self.index.counts_batch(test_points)
+        m = counts.max() if counts.size else 1
+        if pad_to is None and self.pad_policy == "dataset":
+            m = self.index.max_related_count()
+        pad = bucketed_pad(m, self.pad_bucket, pad_to)
+
+        chunk = T
+        if self._cells_bad < (1 << 62) and (
+            T * pad >= self._cells_bad
+            or (self._cells_ok and T * pad > self._cells_ok)
+        ):
+            # Memory pressure has been observed on this engine: never
+            # attempt an untested larger size — a failed dispatch costs
+            # a full XLA compile (40-66 s through the tunnel) before the
+            # error surfaces. Stay at the known-good cell count.
+            good = self._cells_ok // pad
+            chunk = good if good else max(1, (self._cells_bad // pad) // 2)
+            chunk = max(1, min(T, chunk))
+            if chunk < T:
+                # Power-of-two floor: a chunk that doesn't divide T
+                # leaves a different-shaped remainder dispatch, and each
+                # new shape is a fresh 40-66 s XLA compile through the
+                # tunnel (T is a power of two in every real workload).
+                chunk = 1 << (chunk.bit_length() - 1)
+        if chunk >= T:
+            try:
+                out = self._query_padded(test_points, pad)
+            except Exception as e:
+                if T <= 1 or not _is_device_oom(e):
+                    raise
+                self._cells_bad = min(self._cells_bad, T * pad)
+                chunk = max(1, T // 2)
+            else:
+                # Record fast-path successes too: otherwise one
+                # misclassified transient failure would permanently
+                # over-chunk sizes that had dispatched fine for hours.
+                self._cells_ok = max(self._cells_ok, T * pad)
+                return out
+
+        parts: list[InfluenceResult] = []
+        start = 0
+        while start < T:
+            n = min(chunk, T - start)
+            try:
+                parts.append(
+                    self._query_padded(test_points[start : start + n], pad)
+                )
+            except Exception as e:
+                if n <= 1 or not _is_device_oom(e):
+                    raise
+                self._cells_bad = min(self._cells_bad, n * pad)
+                chunk = max(1, n // 2)
+                continue
+            self._cells_ok = max(self._cells_ok, n * pad)
+            start += n
+        return parts[0] if len(parts) == 1 else _concat_results(parts)
 
     def _query_padded(
         self, test_points: np.ndarray, pad_to: int | None

@@ -1,0 +1,47 @@
+#!/bin/bash
+# Frees the chip before the driver's end-of-round bench. The TPU is
+# single-occupancy through the tunnel; a tier-4 fidelity run still
+# holding it at round end would force BENCH_r03 onto the CPU fallback
+# (round 2's biggest miss). At the deadline: kill the chip chains and
+# any chain-launched chip job; CPU-backend hedge jobs (--backend cpu)
+# are left alone, and the hedge watcher then picks up whatever fidelity
+# rows the chain didn't finish. Round started ~09:55 UTC + 12h => ends
+# ~21:55 UTC; the guard fires at 20:30 for margin (tunnel flakiness,
+# compile time).
+set -u
+cd "$(dirname "$0")/.."
+
+exec 9> output/.endguard.lock
+flock -n 9 || exit 0
+
+log() { echo "endguard: $(date) $*" >> output/chain.log; }
+
+DEADLINE_EPOCH=$(date -d "2026-07-31 20:30:00 UTC" +%s)
+now=$(date +%s)
+if [ "$DEADLINE_EPOCH" -gt "$now" ]; then
+  sleep $(( DEADLINE_EPOCH - now ))
+fi
+
+killed=0
+for pat in "bash scripts/chip_chain_r3.sh" "bash scripts/chip_chain_r3b.sh"; do
+  for pid in $(pgrep -f "$pat" || true); do
+    kill "$pid" 2>/dev/null && killed=$((killed + 1))
+  done
+done
+
+# Chain-launched chip jobs: python processes driving the device WITHOUT
+# the CPU backend flag (hedge jobs carry "--backend cpu" and must live).
+for pid in $(pgrep -f "python.*(ab_impls|fia_tpu\.cli\.rq[12]|scripts/stress|bench\.py)" || true); do
+  [ "$pid" = "$$" ] && continue
+  cmd=$(tr '\0' ' ' < "/proc/$pid/cmdline" 2>/dev/null || true)
+  case "$cmd" in
+    *"--backend cpu"*) ;;  # CPU hedge job — keep
+    *) kill "$pid" 2>/dev/null && killed=$((killed + 1)) ;;
+  esac
+done
+
+if [ "$killed" -gt 0 ]; then
+  log "deadline reached; freed the chip (killed $killed chain processes)"
+else
+  log "deadline reached; chip already free"
+fi
