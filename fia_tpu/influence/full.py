@@ -128,6 +128,29 @@ class FullInfluenceEngine:
     # The jitted entry points take flat0/train tensors as ARGUMENTS, not
     # closures: a jit may not close over cross-process global arrays.
 
+    def _chunk_rows(self, train_x, train_y, ci, b):
+        """Gather row chunk ci of b rows from the resident train tensors.
+
+        Shared by the chunked HVP and chunked scoring scans: the ragged
+        tail re-reads row 0 (callers mask it — `_hvp_of` by weight, the
+        scoring path by slicing the stacked output), and each chunk's
+        row axis is sharding-constrained onto 'data' under a mesh.
+        Returns (x, y, valid_mask_f32).
+        """
+        n = self.num_train
+        gidx = ci * b + jnp.arange(b, dtype=jnp.int32)
+        idx = jnp.where(gidx < n, gidx, 0)
+        x, y = train_x[idx], train_y[idx]
+        w = (gidx < n).astype(jnp.float32)
+        if self.mesh is not None:
+            c = lambda a: jax.lax.with_sharding_constraint(
+                a, NamedSharding(
+                    self.mesh, P("data", *([None] * (a.ndim - 1)))
+                )
+            )
+            x, y, w = c(x), c(y), c(w)
+        return x, y, w
+
     def _hvp_of(self, flat0, train_x, train_y, v):
         n = self.num_train
         if self.hvp_batch <= 0 or self.hvp_batch >= n:
@@ -139,21 +162,9 @@ class FullInfluenceEngine:
             return hv + self.damping * v
         b = self.hvp_batch
         nb = -(-n // b)
-        iota = jnp.arange(b, dtype=jnp.int32)
-        mesh = self.mesh
 
         def chunk_hvp(acc, ci):
-            gidx = ci * b + iota
-            w = (gidx < n).astype(jnp.float32)
-            idx = jnp.where(gidx < n, gidx, 0)
-            x, y = train_x[idx], train_y[idx]
-            if mesh is not None:
-                c = lambda a: jax.lax.with_sharding_constraint(
-                    a, NamedSharding(
-                        mesh, P("data", *([None] * (a.ndim - 1)))
-                    )
-                )
-                x, y, w = c(x), c(y), c(w)
+            x, y, w = self._chunk_rows(train_x, train_y, ci, b)
 
             def loss_sum(fvec):
                 p = self._unravel(fvec)
@@ -245,18 +256,44 @@ class FullInfluenceEngine:
 
         Per-example total loss = own squared error + full regulariser, so
         the dot splits into a forward-mode jvp of the per-example error
-        vector plus a constant ∇reg·u term.
+        vector plus a constant ∇reg·u term. When ``hvp_batch`` is set,
+        the jvp scans row chunks exactly like ``_hvp_of``: one
+        full-train jvp materialises (N, k) primal+tangent embedding
+        gathers, and the TPU (8,128) tile layout pads the k=16 minor
+        axis 8x — 4 x 9.54G temporaries = 38.4G for ML-20M, the
+        observed stress OOM (output/stress_full_space.log, 2026-07-31).
         """
-
-        def indiv(fvec):
-            p = self._unravel(fvec)
-            return self.model.indiv_loss(p, train_x, train_y)
-
-        _, err_dots = jax.jvp(indiv, (flat0,), (u,))
+        n = self.num_train
         reg_dot = jax.jvp(
             lambda f: self.model.reg_loss(self._unravel(f)), (flat0,), (u,)
         )[1]
-        return (err_dots + reg_dot) / self.num_train
+        if self.hvp_batch <= 0 or self.hvp_batch >= n:
+
+            def indiv(fvec):
+                p = self._unravel(fvec)
+                return self.model.indiv_loss(p, train_x, train_y)
+
+            _, err_dots = jax.jvp(indiv, (flat0,), (u,))
+            return (err_dots + reg_dot) / n
+
+        b = self.hvp_batch
+        nb = -(-n // b)
+
+        def chunk_dots(carry, ci):
+            x, y, _ = self._chunk_rows(train_x, train_y, ci, b)
+
+            def indiv(fvec):
+                p = self._unravel(fvec)
+                return self.model.indiv_loss(p, x, y)
+
+            _, dots = jax.jvp(indiv, (flat0,), (u,))
+            return carry, dots
+
+        dots = jax.lax.scan(
+            chunk_dots, None, jnp.arange(nb, dtype=jnp.int32)
+        )[1]
+        # ragged-tail rows re-read row 0; the slice drops their dots
+        return (dots.reshape(nb * b)[:n] + reg_dot) / n
 
     def _fetch(self, arr) -> np.ndarray:
         """Host copy of a (possibly cross-process sharded) result."""
