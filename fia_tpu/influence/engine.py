@@ -309,9 +309,13 @@ class InfluenceEngine:
         # the largest (queries x pad) cell count that dispatched
         # successfully, and the smallest that exhausted device memory.
         # Shared across pads — the dominant temporaries scale with
-        # T x pad x block_dim, so cells transfer between pad buckets.
+        # T x pad x block_dim, so cells transfer between pad buckets —
+        # and persisted across processes (utils/memlimits.py) so a
+        # fresh process does not re-pay the failing compile that
+        # taught a previous one the device's envelope.
         self._cells_ok = 0
         self._cells_bad = 1 << 62
+        self._memkey = None
 
     # -- the pure per-test-point query ------------------------------------
     def _query_one(self, params, train_x, train_y, postings, u, i, test_x,
@@ -753,7 +757,55 @@ class InfluenceEngine:
                                        out_counts, ihvp, test_grad)
         return self._query_padded_adaptive(test_points, pad_to)
 
+    def _memlimits_seed(self) -> None:
+        """Adopt the cross-process learned memory envelope (lazy)."""
+        if self._memkey is not None:
+            return
+        from fia_tpu.utils import memlimits
+
+        d = int(
+            self.model.flatten_block(
+                self.model.extract_block(self.params, 0, 0)
+            ).size
+        )
+        ndev = self.mesh.devices.size if self.mesh is not None else 1
+        self._memkey = memlimits.key(
+            jax.default_backend(), ndev, self.model_name, d
+        )
+        ok, bad = memlimits.load(self._memkey)
+        self._cells_ok = max(self._cells_ok, ok)
+        self._cells_bad = min(self._cells_bad, bad)
+        if self._cells_ok >= self._cells_bad:
+            # Inconsistent merged records (e.g. a transient tunnel
+            # failure persisted a bad below a genuine ok, or the cache
+            # travelled between differently-sized chips). Trust the
+            # failure: deriving chunks from a poisoned ok would
+            # re-dispatch a recorded-failing size — a 40-66 s failing
+            # compile per batch, the exact cost this cache avoids.
+            self._cells_ok = self._cells_bad // 2
+
     def _query_padded_adaptive(
+        self, test_points: np.ndarray, pad_to: int | None
+    ) -> InfluenceResult:
+        """Memory-envelope bookkeeping around :meth:`_adaptive_run`."""
+        from fia_tpu.utils import memlimits
+
+        self._memlimits_seed()
+        ok0, bad0 = self._cells_ok, self._cells_bad
+        try:
+            return self._adaptive_run(test_points, pad_to)
+        finally:
+            if (self._cells_ok, self._cells_bad) != (ok0, bad0):
+                try:
+                    memlimits.update(
+                        self._memkey, self._cells_ok, self._cells_bad
+                    )
+                except Exception:
+                    # Envelope persistence must never replace a
+                    # successful query result (this runs in a finally).
+                    pass
+
+    def _adaptive_run(
         self, test_points: np.ndarray, pad_to: int | None
     ) -> InfluenceResult:
         """Dispatch a padded query batch, splitting it when HBM runs out.
@@ -800,6 +852,9 @@ class InfluenceEngine:
                 if T <= 1 or not _is_device_oom(e):
                     raise
                 self._cells_bad = min(self._cells_bad, T * pad)
+                self._cells_ok = min(
+                    self._cells_ok, self._cells_bad // 2
+                )
                 chunk = max(1, T // 2)
             else:
                 # Record fast-path successes too: otherwise one
@@ -820,6 +875,9 @@ class InfluenceEngine:
                 if n <= 1 or not _is_device_oom(e):
                     raise
                 self._cells_bad = min(self._cells_bad, n * pad)
+                self._cells_ok = min(
+                    self._cells_ok, self._cells_bad // 2
+                )
                 chunk = max(1, n // 2)
                 continue
             self._cells_ok = max(self._cells_ok, n * pad)

@@ -1,0 +1,128 @@
+"""Cross-process persistence of learned device-memory envelopes.
+
+The engine's memory-adaptive padded path (`engine.py:
+_query_padded_adaptive`) learns, per engine, the largest (queries x
+pad) cell count that dispatched successfully and the smallest that
+exhausted device HBM. Within one process that stops repeated failing
+compiles — but every fresh process re-pays one 40-66 s failing XLA
+compile (through the tunnel) to rediscover the same ceiling. This
+module shares the learned envelope across processes via a small JSON
+file, keyed by (backend kind, model name, block dim) — the three
+inputs the per-cell temporary cost actually depends on.
+
+Best-effort by design: concurrent writers publish atomically (private
+tmp + rename, the same convention as the inverse-HVP cache —
+docs/design.md §9) and the worst outcome of a lost update is exactly
+the status quo ante: one extra learning failure in some later process.
+Corrupt or unreadable files are ignored and overwritten.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+
+_ENV = "FIA_MEMLIMIT_CACHE"
+_DEFAULT = os.path.join("output", ".mem_limits.json")
+
+_UNSET_BAD = 1 << 62
+
+
+def _path() -> str:
+    return os.environ.get(_ENV, _DEFAULT)
+
+
+def key(
+    backend: str, num_devices: int, model_name: str, block_dim: int
+) -> str:
+    """Cache key for one memory-envelope regime.
+
+    ``num_devices`` matters because the padded path shards T across the
+    mesh — per-device temporaries scale with T x pad / n, so an 8-device
+    envelope is ~8x a single-device one. Chip generation (HBM size) is
+    NOT in the key: `jax.default_backend()` can't see it, so a cache
+    carried between differently-sized chips of one backend kind relies
+    on the ok>=bad clamp at seed time (engine.py:_memlimits_seed) to
+    stay safe.
+    """
+    return f"{backend}:n{int(num_devices)}:{model_name}:d{int(block_dim)}"
+
+
+def load(k: str) -> tuple[int, int]:
+    """(cells_ok, cells_bad) previously learned for key ``k``.
+
+    Returns (0, _UNSET_BAD) — the engine's virgin state — when the
+    cache is absent, unreadable, wrong-shaped, or has no entry.
+    """
+    try:
+        with open(_path()) as f:
+            data = json.load(f)
+        entry = data.get(k) if isinstance(data, dict) else None
+        if not isinstance(entry, dict):
+            return 0, _UNSET_BAD
+        ok = max(0, int(entry.get("cells_ok", 0)))
+        bad = int(entry.get("cells_bad", _UNSET_BAD))
+        if bad <= 0:
+            bad = _UNSET_BAD
+        return ok, bad
+    except (OSError, ValueError, TypeError):
+        return 0, _UNSET_BAD
+
+
+def update(k: str, cells_ok: int, cells_bad: int) -> None:
+    """Merge one engine's learned envelope into the shared cache.
+
+    Merging widens monotonically (max ok, min bad) so concurrent
+    engines can only make the cached envelope more informed. No-ops
+    when there is nothing learned, or when the cache directory does
+    not exist (e.g. library use outside a repo checkout).
+    """
+    if cells_ok <= 0 and cells_bad >= _UNSET_BAD:
+        return
+    path = _path()
+    d = os.path.dirname(path) or "."
+    if not os.path.isdir(d):
+        return
+    try:
+        try:
+            with open(path) as f:
+                data = json.load(f)
+            if not isinstance(data, dict):
+                data = {}
+        except (OSError, ValueError):
+            data = {}
+        prev = data.get(k)
+        if not isinstance(prev, dict):
+            prev = {}
+
+        def _int(v, default):
+            try:
+                return int(v)
+            except (ValueError, TypeError):
+                return default
+
+        merged = {
+            "cells_ok": max(
+                _int(prev.get("cells_ok"), 0), int(cells_ok)
+            ),
+            "cells_bad": min(
+                _int(prev.get("cells_bad"), _UNSET_BAD), int(cells_bad)
+            ),
+        }
+        if merged == prev:
+            return
+        data[k] = merged
+        fd, tmp = tempfile.mkstemp(dir=d, prefix=".mem_limits.")
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(data, f)
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+    except OSError:
+        pass  # best-effort: a lost update costs one re-learning failure

@@ -10,6 +10,14 @@ import sys
 # Force, don't setdefault: the driver environment presets JAX_PLATFORMS
 # to the tunneled TPU, and unit tests must not contend for the one chip.
 os.environ["JAX_PLATFORMS"] = "cpu"
+
+# Isolate tests from the repo's shared learned-memory-envelope cache
+# (utils/memlimits.py): pointing at a nonexistent directory makes load()
+# return the virgin state and update() a no-op. Tests that exercise the
+# persistence itself monkeypatch FIA_MEMLIMIT_CACHE to a tmp path.
+os.environ["FIA_MEMLIMIT_CACHE"] = os.path.join(
+    os.sep, "nonexistent-fia-test", "mem_limits.json"
+)
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

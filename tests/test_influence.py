@@ -607,3 +607,117 @@ class TestAdaptiveChunking:
         for t in range(len(self.PTS)):
             np.testing.assert_allclose(cat.scores_of(t), whole.scores_of(t),
                                        rtol=1e-4, atol=1e-6)
+
+
+class TestMemlimitsPersistence:
+    """utils/memlimits.py: the learned device-memory envelope survives
+    process boundaries (here: engine boundaries with a shared cache
+    file), so a fresh engine pre-chunks instead of re-paying the
+    failing compile that taught a previous one the ceiling."""
+
+    def _engine(self, limit=2):
+        model, params, train = _setup(MF)
+        eng = InfluenceEngine(model, params, train, damping=DAMP,
+                              impl="padded")
+        real = eng._query_padded
+        calls = []
+
+        def fake(test_points, pad_to):
+            calls.append(len(test_points))
+            if len(test_points) > limit:
+                raise RuntimeError("RESOURCE_EXHAUSTED: fake OOM")
+            return real(test_points, pad_to)
+
+        eng._query_padded = fake
+        return eng, calls
+
+    PTS = np.array([[3, 5], [0, 1], [7, 2], [1, 1]], np.int32)
+
+    def test_envelope_survives_to_fresh_engine(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("FIA_MEMLIMIT_CACHE",
+                           str(tmp_path / "mem_limits.json"))
+        first, calls1 = self._engine()
+        first.query_batch(self.PTS)
+        assert calls1[0] == len(self.PTS)  # paid the learning failure
+        assert (tmp_path / "mem_limits.json").exists()
+
+        fresh, calls2 = self._engine()
+        fresh.query_batch(self.PTS)
+        # pre-chunked from the shared cache: no oversized attempt
+        assert all(c <= 2 for c in calls2)
+
+    def test_merge_is_monotonic(self, tmp_path, monkeypatch):
+        from fia_tpu.utils import memlimits
+
+        monkeypatch.setenv("FIA_MEMLIMIT_CACHE",
+                           str(tmp_path / "m.json"))
+        memlimits.update("k", 100, 1000)
+        memlimits.update("k", 50, 2000)   # weaker info must not regress
+        assert memlimits.load("k") == (100, 1000)
+        memlimits.update("k", 200, 800)   # stronger info widens
+        assert memlimits.load("k") == (200, 800)
+        # unknown key / corrupt file -> virgin state
+        assert memlimits.load("other") == (0, 1 << 62)
+        (tmp_path / "m.json").write_text("{corrupt")
+        assert memlimits.load("k") == (0, 1 << 62)
+
+    def test_wrong_shape_json_never_raises(self, tmp_path, monkeypatch):
+        """Valid-JSON-but-wrong-shape cache contents must behave like an
+        absent cache (update runs from a finally in the query path —
+        an escape would replace a successful result with a crash)."""
+        from fia_tpu.utils import memlimits
+
+        f = tmp_path / "m.json"
+        monkeypatch.setenv("FIA_MEMLIMIT_CACHE", str(f))
+        for content in ("[]", "null", '{"k": 5}',
+                        '{"k": {"cells_ok": "x", "cells_bad": null}}'):
+            f.write_text(content)
+            assert memlimits.load("k") == (0, 1 << 62)
+            memlimits.update("k", 10, 100)  # must not raise
+            assert memlimits.load("k") == (10, 100)
+
+    def test_poisoned_cache_clamps_at_seed(self, tmp_path, monkeypatch):
+        """cells_ok >= cells_bad in the merged cache (transient failure
+        recorded below a genuine success) must not make the engine
+        re-dispatch a recorded-failing size."""
+        import jax as _jax
+
+        from fia_tpu.utils import memlimits
+
+        monkeypatch.setenv("FIA_MEMLIMIT_CACHE",
+                           str(tmp_path / "m.json"))
+        model, params, train = _setup(MF)
+        eng = InfluenceEngine(model, params, train, damping=DAMP,
+                              impl="padded")
+        d = int(model.flatten_block(
+            model.extract_block(params, 0, 0)).size)
+        k = memlimits.key(_jax.default_backend(), 1, "model", d)
+        memlimits.update(k, 10_000_000, 512)  # ok far above bad
+        eng._memlimits_seed()
+        assert eng._cells_ok < eng._cells_bad == 512
+        # a 4-query batch at pad 512 (2048 cells >= bad) must pre-chunk
+        real = eng._query_padded
+        sizes = []
+
+        def spy(test_points, pad_to):
+            sizes.append(len(test_points))
+            return real(test_points, pad_to)
+
+        eng._query_padded = spy
+        eng.query_batch(self.PTS)
+        # the invariant, not a specific chunk size: no dispatch may
+        # reach the recorded-failing cell count
+        from fia_tpu.data.index import bucketed_pad
+
+        pad = bucketed_pad(
+            int(eng.index.counts_batch(self.PTS).max()), eng.pad_bucket
+        )
+        assert sizes and all(s * pad < 512 for s in sizes)
+
+    def test_noop_without_cache_dir(self, monkeypatch):
+        from fia_tpu.utils import memlimits
+
+        monkeypatch.setenv("FIA_MEMLIMIT_CACHE",
+                           "/nonexistent-fia-test/m.json")
+        memlimits.update("k", 1, 2)  # must not raise
+        assert memlimits.load("k") == (0, 1 << 62)
