@@ -7,8 +7,9 @@ exhausted device HBM. Within one process that stops repeated failing
 compiles — but every fresh process re-pays one 40-66 s failing XLA
 compile (through the tunnel) to rediscover the same ceiling. This
 module shares the learned envelope across processes via a small JSON
-file, keyed by (backend kind, model name, block dim) — the three
-inputs the per-cell temporary cost actually depends on.
+file, keyed by (backend kind, device count, model name, block dim) —
+the inputs the per-device per-cell temporary cost actually depends on
+(see ``key``).
 
 Best-effort by design: concurrent writers publish atomically (private
 tmp + rename, the same convention as the inverse-HVP cache —

@@ -82,6 +82,13 @@ gate_wait() {
   done
 }
 
+# No new multi-hour CPU jobs late in the round: a hedge started after
+# the 20:30 round-end guard frees the chip would still be grinding the
+# single core when the driver's ~21:55 bench times its torch-CPU
+# baseline, inflating vs_baseline (the r2 W4 problem). A job this late
+# could not finish before round end anyway.
+HEDGE_DEADLINE_EPOCH=$(date -d "2026-07-31 20:00:00 UTC" +%s)
+
 run() {
   local name="$1" logf="$2" chip_ok_re="$3"; shift 3
   # Resume: a restart (host reboot, script relaunch) must not redo a
@@ -90,7 +97,15 @@ run() {
     log "$name skipped (already done by a previous hedge run)"
     return 0
   fi
+  if [ "$(date +%s)" -ge "$HEDGE_DEADLINE_EPOCH" ]; then
+    log "$name skipped (20:00 hedge deadline)"
+    return 0
+  fi
   gate_wait
+  if [ "$(date +%s)" -ge "$HEDGE_DEADLINE_EPOCH" ]; then
+    log "$name skipped (20:00 hedge deadline)"
+    return 0
+  fi
   # Anchor the banked-row check to a full chain line ("chainR3: <date>
   # <tz> <year> <name> ok") — a bare substring match would let the Yelp
   # NCF success line mask the ML-1M NCF job of the same protocol name.

@@ -1,13 +1,13 @@
 #!/bin/bash
-# Frees the chip before the driver's end-of-round bench. The TPU is
+# Frees the machine before the driver's end-of-round bench. The TPU is
 # single-occupancy through the tunnel; a tier-4 fidelity run still
 # holding it at round end would force BENCH_r03 onto the CPU fallback
-# (round 2's biggest miss). At the deadline: kill the chip chains and
-# any chain-launched chip job; CPU-backend hedge jobs (--backend cpu)
-# are left alone, and the hedge watcher then picks up whatever fidelity
-# rows the chain didn't finish. Round started ~09:55 UTC + 12h => ends
-# ~21:55 UTC; the guard fires at 20:30 for margin (tunnel flakiness,
-# compile time).
+# (round 2's biggest miss). At the deadline: kill the chip chains, any
+# chain-launched chip job, AND the CPU hedge (watcher + jobs) — a
+# multi-hour hedge protocol alive this late cannot finish before round
+# end and would share the one core with the bench's torch-CPU baseline.
+# Round started ~09:55 UTC + 12h => ends ~21:55 UTC; the guard fires at
+# 20:30 for margin (tunnel flakiness, compile time).
 set -u
 cd "$(dirname "$0")/.."
 
@@ -23,21 +23,21 @@ if [ "$DEADLINE_EPOCH" -gt "$now" ]; then
 fi
 
 killed=0
-for pat in "bash scripts/chip_chain_r3.sh" "bash scripts/chip_chain_r3b.sh"; do
+for pat in "bash scripts/chip_chain_r3.sh" "bash scripts/chip_chain_r3b.sh" \
+           "bash scripts/cpu_hedge2_r3.sh"; do
   for pid in $(pgrep -f "$pat" || true); do
     kill "$pid" 2>/dev/null && killed=$((killed + 1))
   done
 done
 
-# Chain-launched chip jobs: python processes driving the device WITHOUT
-# the CPU backend flag (hedge jobs carry "--backend cpu" and must live).
+# All measurement jobs die at the deadline — chip jobs to free the
+# single-occupancy device, and CPU hedge jobs ("--backend cpu") too:
+# hedge2 only runs multi-hour protocols, so one still alive now cannot
+# finish before round end, and it would share the one core with the
+# driver's ~21:55 bench, inflating vs_baseline (the r2 W4 problem).
 for pid in $(pgrep -f "python.*(ab_impls|fia_tpu\.cli\.rq[12]|scripts/stress|bench\.py)" || true); do
   [ "$pid" = "$$" ] && continue
-  cmd=$(tr '\0' ' ' < "/proc/$pid/cmdline" 2>/dev/null || true)
-  case "$cmd" in
-    *"--backend cpu"*) ;;  # CPU hedge job — keep
-    *) kill "$pid" 2>/dev/null && killed=$((killed + 1)) ;;
-  esac
+  kill "$pid" 2>/dev/null && killed=$((killed + 1))
 done
 
 if [ "$killed" -gt 0 ]; then
