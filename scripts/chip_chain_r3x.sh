@@ -94,16 +94,24 @@ past_deadline && exit 0
 log "extras starting"
 wait_tunnel
 
-run_watched "NCF ML wide-sample RQ1 (6k x 3, 8 pts)" output/rq1_ncf_ml_cal2_6k3_n8.log \
-  python -m fia_tpu.cli.rq1 --dataset movielens --data_dir /root/reference/data \
-  --model NCF --num_test 8 --num_steps_train 12000 \
-  --num_steps_retrain 6000 --retrain_times 3 --batch_size 3020 \
-  --lane_chunk 16 --steps_per_dispatch 1000
-
+# Quick jobs first: each 2k x 2 wide-sample is ~20-30 chip-minutes, so
+# a deadline kill loses at most the job in flight; the multi-hour
+# 6k x 3 widener runs last, only if time remains.
 run_watched "MF ML wide-sample RQ1 (2k x 2, 8 pts)" output/rq1_mf_ml_cal2_2k2_n8.log \
   python -m fia_tpu.cli.rq1 --dataset movielens --data_dir /root/reference/data \
   --model MF --num_test 8 --num_steps_train 15000 \
   --num_steps_retrain 2000 --retrain_times 2 --batch_size 3020
+
+run_watched "NCF ML wide-sample RQ1 (2k x 2, 8 pts)" output/rq1_ncf_ml_cal2_2k2_n8.log \
+  python -m fia_tpu.cli.rq1 --dataset movielens --data_dir /root/reference/data \
+  --model NCF --num_test 8 --num_steps_train 12000 \
+  --num_steps_retrain 2000 --retrain_times 2 --batch_size 3020 \
+  --lane_chunk 16 --steps_per_dispatch 1000
+
+run_watched "MF yelp wide-sample RQ1 (2k x 2, 8 pts)" output/rq1_mf_yelp_cal2_2k2_n8.log \
+  python -m fia_tpu.cli.rq1 --dataset yelp --data_dir /root/reference/data \
+  --model MF --num_test 8 --num_steps_train 15000 \
+  --num_steps_retrain 2000 --retrain_times 2 --batch_size 3009
 
 run_watched "NCF yelp wide-sample RQ1 (2k x 2, 8 pts)" output/rq1_ncf_yelp_cal2_2k2_n8.log \
   python -m fia_tpu.cli.rq1 --dataset yelp --data_dir /root/reference/data \
@@ -111,9 +119,10 @@ run_watched "NCF yelp wide-sample RQ1 (2k x 2, 8 pts)" output/rq1_ncf_yelp_cal2_
   --num_steps_retrain 2000 --retrain_times 2 --batch_size 3009 \
   --lane_chunk 16 --steps_per_dispatch 1000
 
-run_watched "MF yelp wide-sample RQ1 (2k x 2, 8 pts)" output/rq1_mf_yelp_cal2_2k2_n8.log \
-  python -m fia_tpu.cli.rq1 --dataset yelp --data_dir /root/reference/data \
-  --model MF --num_test 8 --num_steps_train 15000 \
-  --num_steps_retrain 2000 --retrain_times 2 --batch_size 3009
+run_watched "NCF ML wide-sample RQ1 (6k x 3, 8 pts)" output/rq1_ncf_ml_cal2_6k3_n8.log \
+  python -m fia_tpu.cli.rq1 --dataset movielens --data_dir /root/reference/data \
+  --model NCF --num_test 8 --num_steps_train 12000 \
+  --num_steps_retrain 6000 --retrain_times 3 --batch_size 3020 \
+  --lane_chunk 16 --steps_per_dispatch 1000
 
 log "extras done"
