@@ -27,6 +27,12 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 QUICK = "--quick" in sys.argv
 
 
+def _timed(fn) -> float:
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
+
+
 def _stage(msg: str) -> None:
     """Progress marker on stderr (stdout carries only the JSON line)."""
     print(f"bench[{time.strftime('%H:%M:%S')}]: {msg}", file=sys.stderr, flush=True)
@@ -129,6 +135,51 @@ def main():
     _stage(f"timing {n_queries} influence queries")
     timing = time_influence_queries(engine, points, repeats=3)
     log.log("query_batch", model="MF", **timing.json())
+
+    # Device-program stage split (VERDICT r3 item 8): time the flat
+    # program's prefix truncations (grads -> +hessian -> +solve ->
+    # +scores) so every future round tracks where device time goes
+    # without a separate A/B run. Best-effort: a failure here must not
+    # cost the headline numbers. Skipped in --quick (3 extra compiles).
+    device_split = {}
+    if not QUICK:
+        try:
+            import jax.numpy as jnp
+
+            from fia_tpu.data.index import bucketed_pad
+
+            s_pad = bucketed_pad(
+                int(engine.index.counts_batch(points).sum()), 2048
+            )
+            split_args = (engine.params, engine.train_x, engine.train_y,
+                          engine._postings, jnp.asarray(points, jnp.int32))
+            stages = ("grads", "hessian", "solve", "scores")
+            fns = {}
+            for st in stages:
+                fns[st] = engine._flat_fn(s_pad, stage=st)
+                jax.block_until_ready(fns[st](*split_args))  # compile+warm
+            # INTERLEAVED rounds (the tunneled chip's run-to-run
+            # variance swamps sequential stage comparisons), then a
+            # monotone clamp: a prefix program can still time under an
+            # earlier prefix's best, and a negative stage delta in the
+            # log would be nonsense
+            best = {st: float("inf") for st in stages}
+            for _ in range(3):
+                for st in stages:
+                    best[st] = min(best[st], _timed(
+                        lambda f=fns[st]: jax.block_until_ready(
+                            f(*split_args)
+                        )
+                    ))
+            prev = 0.0
+            for st in stages:
+                cum = max(best[st], prev)
+                device_split[st + "_ms"] = round((cum - prev) * 1e3, 2)
+                prev = cum
+            device_split["full_program_ms"] = round(prev * 1e3, 2)
+            log.log("device_split", model="MF", **device_split)
+        except Exception as e:  # noqa: BLE001
+            device_split = {"error": repr(e)}
     _stage(f"jax path done ({timing.scores_per_sec:.0f} scores/s); "
            f"timing pipelined query_many")
 
@@ -256,6 +307,7 @@ def main():
             "train_steps": steps,
             "train_stream": stream,
             "pipelined": pipelined,
+            "device_split": device_split,
             "ncf": ncf_out,
         },
     }

@@ -161,11 +161,12 @@ def synthesize_calibrated(
     num_users: int,
     num_items: int,
     num_rows: int,
-    heldout_x: np.ndarray,
+    heldout_x: np.ndarray | None = None,
     seed: int = 0,
     min_degree: int = 16,
     rank: int = 8,
     noise: float = 0.4,
+    item_zipf: float = 0.9,
 ) -> RatingDataset:
     """Train split calibrated to the reference's real valid/test files.
 
@@ -179,12 +180,28 @@ def synthesize_calibrated(
     double-count its row in related sets and Hessians), and every
     heldout item is guaranteed at least one train row so FIA queries
     have non-empty related sets on both sides.
+
+    ``heldout_x=None`` (scales with no reference split, e.g. ML-20M
+    stress — r4): item popularity falls back to a permuted
+    Zipf(``item_zipf``) profile; everything STRUCTURAL — waterfilled
+    user degrees, unique pairs, exact row count — still holds, so the
+    stream keeps cal2's realism guarantees minus the empirical item
+    marginal (which no surviving data can pin at that scale).
     """
     rng = np.random.default_rng(seed)
-    heldout_x = np.asarray(heldout_x)
-    ic = np.bincount(heldout_x[:, 1], minlength=num_items).astype(np.float64)
-    p_item = ic + 0.5
-    p_item /= p_item.sum()
+    if heldout_x is None:
+        ic = np.zeros(num_items, np.float64)
+        p_item = 1.0 / np.arange(1, num_items + 1) ** item_zipf
+        p_item = p_item[rng.permutation(num_items)]
+        p_item /= p_item.sum()
+        heldout_x = np.empty((0, 2), np.int64)
+    else:
+        heldout_x = np.asarray(heldout_x)
+        ic = np.bincount(
+            heldout_x[:, 1], minlength=num_items
+        ).astype(np.float64)
+        p_item = ic + 0.5
+        p_item /= p_item.sum()
 
     # cap degrees at num_items - 8: a user holds each item at most once,
     # and ~4 items per user live in the heldout split (leave-4-out), so

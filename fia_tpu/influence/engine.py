@@ -112,25 +112,30 @@ class InfluenceResult:
         return self.related_idx[t, : self.counts[t]]
 
 
-def _is_device_oom(e: Exception) -> bool:
-    """Was this dispatch/compile failure plausibly device-memory exhaustion?
+def _classify_device_failure(e: Exception) -> str | None:
+    """Classify a dispatch/compile failure for the adaptive retry layer.
 
-    Local backends raise RESOURCE_EXHAUSTED / "Ran out of memory" in the
-    exception text. Tunnel-attached TPUs (axon remote compile) wrap the
-    XLA error in a generic "HTTP 500: tpu_compile_helper subprocess exit
-    code N" whose OOM detail only reaches stderr — treat those as
-    possibly-OOM too: the adaptive retry halves the batch at most
-    log2(T) times and re-raises at chunk=1, so misclassifying a genuine
-    compile bug costs bounded retries, while missing an OOM kills a
-    multi-hour run (observed: 256-query NCF batch at pad 4608, 16.06G of
-    15.75G HBM).
+    Returns:
+      ``"oom"`` — the backend said so explicitly (RESOURCE_EXHAUSTED /
+        "Ran out of memory"): definite evidence, safe to persist in the
+        cross-process memory envelope.
+      ``"ambiguous"`` — tunnel-attached TPUs (axon remote compile) wrap
+        the XLA error in a generic "HTTP 500: tpu_compile_helper
+        subprocess exit code N" whose OOM detail only reaches stderr.
+        Could be OOM (observed: 256-query NCF batch at pad 4608, 16.06G
+        of 15.75G HBM) or a transient tunnel fault: the adaptive layer
+        retries ONCE at the same size before halving, and keeps what it
+        learns from these in-process only — one flaky HTTP 500 must not
+        poison the shared envelope for every later process (r3 advisor
+        finding).
+      ``None`` — unrelated failure; re-raise.
     """
     s = str(e)
-    return (
-        "RESOURCE_EXHAUSTED" in s
-        or "out of memory" in s.lower()
-        or "tpu_compile_helper subprocess exit code" in s
-    )
+    if "RESOURCE_EXHAUSTED" in s or "out of memory" in s.lower():
+        return "oom"
+    if "tpu_compile_helper subprocess exit code" in s:
+        return "ambiguous"
+    return None
 
 
 def _concat_results(parts: list["InfluenceResult"]) -> "InfluenceResult":
@@ -205,6 +210,7 @@ class InfluenceEngine:
         pad_policy: str = "batch",
         impl: str = "auto",
         flat_chunk: int = 2048,
+        flat_accum: str = "auto",
     ):
         if solver not in ("direct", "cg", "lissa", "schulz"):
             raise ValueError(f"unknown solver {solver!r}")
@@ -304,6 +310,15 @@ class InfluenceEngine:
         # steps at more VMEM/HBM (2048 ~ 9.5 MB at d=34). Rounded down to
         # a power of two so it always divides the power-of-two S pad.
         self.flat_chunk = 1 << max(0, int(flat_chunk).bit_length() - 1)
+        # Flat-path per-query Hessian segment reduction: 'scan' is the
+        # scatter-add form (VPU serial, memory-lean), 'onehot' the
+        # (T, chunk) @ (chunk, d²) matmul form (MXU; chip A/B winner,
+        # BASELINE §4.3). 'auto' picks onehot on TPU, scan elsewhere
+        # (CPU has no MXU to feed — the one-hot multiplies are pure
+        # waste there).
+        if flat_accum not in ("auto", "scan", "onehot"):
+            raise ValueError(f"unknown flat_accum {flat_accum!r}")
+        self.flat_accum = flat_accum
         self._jitted = {}  # pad length -> compiled batched query
         # Memory-adaptive padded-path state (_query_padded_adaptive):
         # the largest (queries x pad) cell count that dispatched
@@ -314,7 +329,20 @@ class InfluenceEngine:
         # fresh process does not re-pay the failing compile that
         # taught a previous one the device's envelope.
         self._cells_ok = 0
+        # _cells_bad: the effective in-process ceiling — min over every
+        # failure observed, whatever its class. _cells_bad_hard: min
+        # over explicit RESOURCE_EXHAUSTED failures only; this is the
+        # ONLY value the cross-process cache ever receives — a generic
+        # tunnel-500 (possibly a transient fault) chunks this engine
+        # but must not degrade every later process (r3 advisor
+        # finding). Tracked separately so an ambiguous fault at a small
+        # size cannot shadow a genuine OOM ceiling learned earlier.
         self._cells_bad = 1 << 62
+        self._cells_bad_hard = 1 << 62
+        # Largest successful dispatch that contradicted a recorded
+        # ceiling (success at >= cells_bad); the persistence layer then
+        # clears stale cached ceilings <= this size. 0 = none.
+        self._cleared_bad = 0
         self._memkey = None
 
     # -- the pure per-test-point query ------------------------------------
@@ -393,9 +421,9 @@ class InfluenceEngine:
         return self._jitted[pad]
 
     # -- flat segment-sum query path --------------------------------------
-    def _flat_fn(self, s_pad: int):
+    def _flat_fn(self, s_pad: int, stage: str = "scores"):
         """All queries' related rows concatenated into one flat (S,)
-        axis; per-query Hessians accumulated by segment scatter-add.
+        axis; per-query Hessians accumulated by segment reduction.
 
         The padded per-query layout wastes compute proportionally to
         max/mean related-set skew (~10× on ML-1M: pad 3584 vs mean 356);
@@ -405,10 +433,19 @@ class InfluenceEngine:
         Outputs are identical in layout to ``_batched_packed``: flat
         scores in query order (user postings then item postings), plus
         (T, d) ihvp and test vectors.
+
+        ``stage`` truncates the program for roofline accounting
+        (scripts/roofline.py): "grads" stops after the per-row block
+        gradients, "hessian" after the segment-reduced Hessians,
+        "solve" after the batched solves; "scores" (default) is the
+        full program. Stages are cumulative prefixes of one program, so
+        best-of-N time differences attribute device cost per stage.
         """
-        key = ("flat", s_pad)
+        key = ("flat", s_pad, stage)
         if key in self._jitted:
             return self._jitted[key]
+        if stage not in ("grads", "hessian", "solve", "scores"):
+            raise ValueError(f"unknown stage {stage!r}")
         model = self.model
         mesh = self.mesh
         d = model.block_size
@@ -486,22 +523,59 @@ class InfluenceEngine:
 
             g = jax.vmap(one_g)(rel_x, ut, it)  # (S, d)
             e = model.predict(params, rel_x) - rel_y
+            if stage == "grads":
+                return g, e
 
             # H_t = (2/n_t) Σ_{s∈t} w (g gᵀ + a b e C) + diag(reg) + λI,
             # accumulated in chunks so the outer-product buffer stays small
             ab = wv * (rel_x[:, 0] == ut) * (rel_x[:, 1] == it)
 
+            onehot = self.flat_accum == "onehot" or (
+                self.flat_accum == "auto"
+                and jax.default_backend() == "tpu"
+            )
+
             def accum(g_r, t_r, w_r, abe_r):
                 """Chunked scan: (nc, chunk, ...) -> (T, d, d), (T,)."""
 
-                def body(carry, args):
+                def body_scatter(carry, args):
                     acc, s_abe = carry
                     gc, tc, wc, ac = args
                     outer = (gc * wc[:, None])[:, :, None] * gc[:, None, :]
                     return (acc.at[tc].add(outer), s_abe.at[tc].add(ac)), None
 
+                def body_onehot(carry, args):
+                    # Segment reduction as one (T, chunk) @ (chunk, d²)
+                    # matmul: scatter-adds serialize on the VPU
+                    # (row-at-a-time accumulate), while the one-hot
+                    # contraction rides the MXU — the wasted multiplies
+                    # on zero one-hot entries are far cheaper than the
+                    # scatter's serialization (chip A/B, BASELINE §4.3).
+                    # fp32 einsum: Hessian entries accumulate hundreds
+                    # of rows; bf16 mantissas would cost real fidelity.
+                    acc, s_abe = carry
+                    gc, tc, wc, ac = args
+                    oh = (
+                        tc[:, None] == jnp.arange(T, dtype=tc.dtype)[None, :]
+                    ).astype(jnp.float32)  # (chunk, T)
+                    outer = (
+                        (gc * wc[:, None])[:, :, None] * gc[:, None, :]
+                    ).reshape(-1, d * d)
+                    Hc = jax.lax.dot_general(
+                        oh, outer,
+                        (((0,), (0,)), ((), ())),
+                        precision=jax.lax.Precision.HIGHEST,
+                    )  # (T, d²)
+                    # elementwise-masked sum, not oh.T @ ac: a default-
+                    # precision matmul would round abe to bf16 on TPU
+                    # while the Hessian contraction above runs HIGHEST
+                    return (
+                        acc + Hc.reshape(T, d, d),
+                        s_abe + jnp.sum(oh * ac[:, None], axis=0),
+                    ), None
+
                 (acc, s_abe), _ = jax.lax.scan(
-                    body,
+                    body_onehot if onehot else body_scatter,
                     (jnp.zeros((T, d, d), jnp.float32),
                      jnp.zeros((T,), jnp.float32)),
                     (g_r, t_r, w_r, abe_r),
@@ -531,6 +605,8 @@ class InfluenceEngine:
             H = (2.0 / n_t)[:, None, None] * (
                 HH + sum_abe[:, None, None] * C[None]
             ) + jnp.diag(rdiag + self.damping)[None]
+            if stage == "hessian":
+                return H
 
             v = jax.vmap(
                 lambda uu, ii, xj: G.block_prediction_grad(
@@ -538,6 +614,8 @@ class InfluenceEngine:
                 )
             )(u, i, tx)
             ihvp = jax.vmap(solvers.solve_direct)(H, v)
+            if stage == "solve":
+                return ihvp, v
 
             # score_s = ∇_block L(z_s) · ihvp_t / n_t, with the per-example
             # loss gradient 2 e g + wd·θ̃ (θ̃ = decayed block dims)
@@ -550,6 +628,15 @@ class InfluenceEngine:
             scores = wv * (
                 2.0 * e * jnp.einsum("sd,sd->s", g, ihvp[t]) + reg_dot[t]
             ) / n_t[t]
+            if mesh is not None:
+                # pin output shardings: scores stay flat-axis-sharded,
+                # the per-query solves replicate — so the multi-host
+                # fetch (process_allgather in _assemble_packed) sees a
+                # deterministic layout instead of whatever GSPMD chose
+                rep = NamedSharding(mesh, P())
+                scores = c(scores)
+                ihvp = jax.lax.with_sharding_constraint(ihvp, rep)
+                v = jax.lax.with_sharding_constraint(v, rep)
             return scores, ihvp, v
 
         self._jitted[key] = jax.jit(fn)
@@ -557,11 +644,11 @@ class InfluenceEngine:
 
     def _flat_eligible(self) -> bool:
         return (
-            # single-process meshes shard the flat axis (per-device
-            # partial Hessians + psum); multi-host output assembly would
-            # need a process allgather — padded path covers that regime
-            not self._multihost
-            and self.solver == "direct"
+            # meshes (single- or multi-process) shard the flat axis with
+            # per-device partial Hessians + one psum; multi-host output
+            # assembly rides the same process allgather as the padded
+            # path (r3 VERDICT item 5 — the fast path now covers pods)
+            self.solver == "direct"
             and not self.group_queries
             # the flat path always builds the Hessian from the analytic
             # GN hooks — an explicit 'autodiff' request must be honored
@@ -593,6 +680,12 @@ class InfluenceEngine:
             gran = math.gcd(s_pad, self.flat_chunk) * self.mesh.shape["data"]
             s_pad = -(-s_pad // gran) * gran
         tx = jnp.asarray(test_points, jnp.int32)
+        if self._multihost:
+            # cross-process jit operands must be global arrays; every
+            # process holds the same query batch (replicated input)
+            from fia_tpu.parallel.distributed import put_global
+
+            tx = put_global(self.mesh, tx, P())
         out = self._flat_fn(s_pad)(
             self.params, self.train_x, self.train_y, self._postings, tx
         )
@@ -656,7 +749,17 @@ class InfluenceEngine:
         order (user postings then item postings) — consumers reading
         ``scores_of``/``related_of`` never pay for padding.
         """
-        packed, ihvp, v = jax.device_get(out)
+        if self._multihost:
+            # outputs live partly on non-addressable devices; gather
+            # every process a full host copy (same path as the padded
+            # engine's multi-host fetch at _query_padded)
+            from jax.experimental import multihost_utils
+
+            packed, ihvp, v = multihost_utils.process_allgather(
+                out, tiled=True
+            )
+        else:
+            packed, ihvp, v = jax.device_get(out)
         total = int(counts.sum())
         return InfluenceResult(
             counts=counts,
@@ -722,8 +825,8 @@ class InfluenceEngine:
         if self.impl == "flat":
             raise ValueError(
                 "impl='flat' requires the direct solver, a model defining "
-                "the Gauss-Newton hooks, and a single-process (possibly "
-                "multi-device) engine"
+                "the Gauss-Newton hooks, pad_policy='batch', and no "
+                "explicit hessian_mode='autodiff'"
             )
 
         if self.group_queries and pad_to is None and T > 1:
@@ -774,7 +877,10 @@ class InfluenceEngine:
         )
         ok, bad = memlimits.load(self._memkey)
         self._cells_ok = max(self._cells_ok, ok)
+        # cached ceilings were persisted only for explicit OOMs, so a
+        # loaded bad is hard evidence (still clearable by a success)
         self._cells_bad = min(self._cells_bad, bad)
+        self._cells_bad_hard = min(self._cells_bad_hard, bad)
         if self._cells_ok >= self._cells_bad:
             # Inconsistent merged records (e.g. a transient tunnel
             # failure persisted a bad below a genuine ok, or the cache
@@ -784,6 +890,49 @@ class InfluenceEngine:
             # compile per batch, the exact cost this cache avoids.
             self._cells_ok = self._cells_bad // 2
 
+    def _record_ok(self, cells: int) -> None:
+        self._cells_ok = max(self._cells_ok, cells)
+        if cells >= self._cells_bad_hard:
+            # A success at/above a recorded failing size is direct
+            # evidence that record was wrong (a transient fault misread
+            # as memory pressure). Clear it — and remember the success
+            # size so the persisted copy is cleared too (r3 advisor
+            # finding: a stale ceiling otherwise degrades every later
+            # process until the cache file is hand-deleted).
+            self._cells_bad_hard = 1 << 62
+            self._cleared_bad = max(self._cleared_bad, cells)
+        if cells >= self._cells_bad:
+            # Ambiguous ceilings <= the success are refuted as well;
+            # any surviving hard ceiling (> cells) stays binding.
+            self._cells_bad = self._cells_bad_hard
+            self._cleared_bad = max(self._cleared_bad, cells)
+
+    def _record_bad(self, cells: int, definite: bool) -> None:
+        self._cells_bad = min(self._cells_bad, cells)
+        if definite:
+            self._cells_bad_hard = min(self._cells_bad_hard, cells)
+        self._cells_ok = min(self._cells_ok, self._cells_bad // 2)
+
+    def _dispatch_padded_resilient(
+        self, test_points: np.ndarray, pad: int | None
+    ) -> InfluenceResult:
+        """One padded dispatch; ambiguous tunnel failures retry once.
+
+        A generic tunnel HTTP 500 is as likely a transient fault as a
+        wrapped OOM; halving straight away costs a fresh 40-66 s XLA
+        compile at the new shape AND (before r4) taught the envelope a
+        false ceiling. One same-size retry is free when the fault was
+        transient (the compile is already cached) and bounded when it
+        was real. Definite OOMs skip the retry — re-dispatching a size
+        the backend just measured as over-memory cannot succeed.
+        """
+        try:
+            return self._query_padded(test_points, pad)
+        except Exception as e:
+            if _classify_device_failure(e) != "ambiguous":
+                raise
+            return self._query_padded(test_points, pad)
+
     def _query_padded_adaptive(
         self, test_points: np.ndarray, pad_to: int | None
     ) -> InfluenceResult:
@@ -791,15 +940,26 @@ class InfluenceEngine:
         from fia_tpu.utils import memlimits
 
         self._memlimits_seed()
-        ok0, bad0 = self._cells_ok, self._cells_bad
+        state0 = (self._cells_ok, self._cells_bad_hard, self._cleared_bad)
         try:
             return self._adaptive_run(test_points, pad_to)
         finally:
-            if (self._cells_ok, self._cells_bad) != (ok0, bad0):
+            state1 = (self._cells_ok, self._cells_bad_hard,
+                      self._cleared_bad)
+            if state1 != state0:
                 try:
+                    # Only hard (RESOURCE_EXHAUSTED) ceilings reach the
+                    # shared cache: persisting a possibly-transient
+                    # tunnel fault would degrade all future processes
+                    # (min-merge never forgets). A contradicted ceiling
+                    # is actively cleared instead.
                     memlimits.update(
-                        self._memkey, self._cells_ok, self._cells_bad
+                        self._memkey,
+                        self._cells_ok,
+                        self._cells_bad_hard,
+                        clear_bad_at=self._cleared_bad or None,
                     )
+                    self._cleared_bad = 0
                 except Exception:
                     # Envelope persistence must never replace a
                     # successful query result (this runs in a finally).
@@ -847,20 +1007,18 @@ class InfluenceEngine:
                 chunk = 1 << (chunk.bit_length() - 1)
         if chunk >= T:
             try:
-                out = self._query_padded(test_points, pad)
+                out = self._dispatch_padded_resilient(test_points, pad)
             except Exception as e:
-                if T <= 1 or not _is_device_oom(e):
+                cls = _classify_device_failure(e)
+                if T <= 1 or cls is None:
                     raise
-                self._cells_bad = min(self._cells_bad, T * pad)
-                self._cells_ok = min(
-                    self._cells_ok, self._cells_bad // 2
-                )
+                self._record_bad(T * pad, cls == "oom")
                 chunk = max(1, T // 2)
             else:
                 # Record fast-path successes too: otherwise one
                 # misclassified transient failure would permanently
                 # over-chunk sizes that had dispatched fine for hours.
-                self._cells_ok = max(self._cells_ok, T * pad)
+                self._record_ok(T * pad)
                 return out
 
         parts: list[InfluenceResult] = []
@@ -869,18 +1027,18 @@ class InfluenceEngine:
             n = min(chunk, T - start)
             try:
                 parts.append(
-                    self._query_padded(test_points[start : start + n], pad)
+                    self._dispatch_padded_resilient(
+                        test_points[start : start + n], pad
+                    )
                 )
             except Exception as e:
-                if n <= 1 or not _is_device_oom(e):
+                cls = _classify_device_failure(e)
+                if n <= 1 or cls is None:
                     raise
-                self._cells_bad = min(self._cells_bad, n * pad)
-                self._cells_ok = min(
-                    self._cells_ok, self._cells_bad // 2
-                )
+                self._record_bad(n * pad, cls == "oom")
                 chunk = max(1, n // 2)
                 continue
-            self._cells_ok = max(self._cells_ok, n * pad)
+            self._record_ok(n * pad)
             start += n
         return parts[0] if len(parts) == 1 else _concat_results(parts)
 

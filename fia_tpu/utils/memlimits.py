@@ -71,15 +71,34 @@ def load(k: str) -> tuple[int, int]:
         return 0, _UNSET_BAD
 
 
-def update(k: str, cells_ok: int, cells_bad: int) -> None:
+def update(
+    k: str,
+    cells_ok: int,
+    cells_bad: int,
+    *,
+    clear_bad_at: int | None = None,
+) -> None:
     """Merge one engine's learned envelope into the shared cache.
 
     Merging widens monotonically (max ok, min bad) so concurrent
     engines can only make the cached envelope more informed. No-ops
     when there is nothing learned, or when the cache directory does
     not exist (e.g. library use outside a repo checkout).
+
+    ``clear_bad_at``: the caller observed a dispatch of this many cells
+    SUCCEED at or above a previously recorded failing size — direct
+    evidence that record was a misclassified transient fault, not a
+    memory ceiling. Any stored ``cells_bad`` at or below the observed
+    success is dropped; a stored bad strictly above it remains
+    plausible and survives. (The observed size, not the merged
+    ``cells_ok``, is the comparison point: a stale over-large ok from
+    an old cache must not launder away a genuine ceiling.) Callers must
+    only persist ``cells_bad`` values learned from explicit
+    RESOURCE_EXHAUSTED errors; ambiguous tunnel failures stay
+    in-process (r3 advisor finding — a poisoned shared ceiling
+    degraded every later process until hand-deleted).
     """
-    if cells_ok <= 0 and cells_bad >= _UNSET_BAD:
+    if cells_ok <= 0 and cells_bad >= _UNSET_BAD and clear_bad_at is None:
         return
     path = _path()
     d = os.path.dirname(path) or "."
@@ -103,14 +122,16 @@ def update(k: str, cells_ok: int, cells_bad: int) -> None:
             except (ValueError, TypeError):
                 return default
 
-        merged = {
-            "cells_ok": max(
-                _int(prev.get("cells_ok"), 0), int(cells_ok)
-            ),
-            "cells_bad": min(
-                _int(prev.get("cells_bad"), _UNSET_BAD), int(cells_bad)
-            ),
-        }
+        merged_ok = max(_int(prev.get("cells_ok"), 0), int(cells_ok))
+        prev_bad = _int(prev.get("cells_bad"), _UNSET_BAD)
+        # The clear applies to the PREVIOUSLY stored ceiling only; the
+        # caller's own cells_bad is newer evidence than its observed
+        # success and must survive the merge (a run can clear a stale
+        # ceiling AND re-learn a genuine one at the same size).
+        if clear_bad_at is not None and prev_bad <= int(clear_bad_at):
+            prev_bad = _UNSET_BAD
+        merged_bad = min(prev_bad, int(cells_bad))
+        merged = {"cells_ok": merged_ok, "cells_bad": merged_bad}
         if merged == prev:
             return
         data[k] = merged

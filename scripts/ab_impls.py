@@ -163,14 +163,23 @@ def main():
     # sanity: variants agree on the final round's scores. Tolerances sized
     # for cross-impl float drift at chip scale: the padded engine may
     # dispatch in memory-adaptive chunks (different vmap widths reorder
-    # the 64-dim NCF solve reductions; observed max 4.5e-5 abs / 3.8% rel
-    # on the smallest scores) — rank agreement is the meaningful bar, so
-    # assert near-perfect Pearson per query alongside loose elementwise.
+    # the 64-dim NCF solve reductions; observed max 4.5e-5 abs / 3.8% rel,
+    # and the relative drift only on the smallest scores) — so the
+    # elementwise check is two-banded: tight relative (1e-2, was 5e-2)
+    # on scores above a 1e-3-of-max magnitude floor, absolute-only below
+    # it, plus a near-perfect per-query Pearson backstop for rank
+    # agreement. Both bands keep the 1e-4 absolute floor: the observed
+    # 4.5e-5 abs drift is magnitude-independent, so a tiny atol on the
+    # big band would false-fail band-boundary scores whenever the
+    # query's max score is small.
     ref = last["flat"]
     for name, s in last.items():
         for t in range(0, B, 61):
             a, r = s.scores_of(t), ref.scores_of(t)
-            np.testing.assert_allclose(a, r, rtol=5e-2, atol=1e-4)
+            scale = float(np.abs(r).max()) if r.size else 0.0
+            big = np.abs(r) >= 1e-3 * scale
+            np.testing.assert_allclose(a[big], r[big], rtol=1e-2, atol=1e-4)
+            np.testing.assert_allclose(a[~big], r[~big], rtol=0, atol=1e-4)
             if a.size >= 3 and np.std(a) > 0 and np.std(r) > 0:
                 rho = float(np.corrcoef(a, r)[0, 1])
                 assert rho > 0.99999, f"{name} q{t}: pearson {rho}"

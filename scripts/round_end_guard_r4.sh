@@ -1,0 +1,41 @@
+#!/bin/bash
+# Frees the machine before the driver's end-of-round bench (round 4).
+# The TPU is single-occupancy through the tunnel; a fidelity run still
+# holding it at round end would force BENCH_r04 onto the CPU fallback
+# (round 2's biggest miss). At the deadline: kill the chip chain, any
+# chain-launched chip job, AND any CPU-backend measurement jobs — a
+# multi-hour protocol alive this late cannot finish before round end
+# and would share the one core with the bench's torch-CPU baseline.
+# Round 4 started ~21:09 UTC Jul 31 + 12h => ends ~09:09 UTC Aug 1;
+# the guard fires at 07:45 for margin (tunnel flakiness, compile time).
+set -u
+cd "$(dirname "$0")/.."
+
+exec 9> output/.endguard_r4.lock
+flock -n 9 || exit 0
+
+log() { echo "endguardR4: $(date) $*" >> output/chain.log; }
+
+DEADLINE_EPOCH=$(date -d "2026-08-01 07:45:00 UTC" +%s)
+now=$(date +%s)
+if [ "$DEADLINE_EPOCH" -gt "$now" ]; then
+  sleep $(( DEADLINE_EPOCH - now ))
+fi
+
+killed=0
+for pat in "bash scripts/chip_chain_r4.sh" "bash scripts/chip_chain_r4b.sh"; do
+  for pid in $(pgrep -f "$pat" || true); do
+    kill "$pid" 2>/dev/null && killed=$((killed + 1))
+  done
+done
+
+for pid in $(pgrep -f "python.*(ab_impls|roofline|fia_tpu\.cli\.rq[12]|scripts/stress|bench\.py)" || true); do
+  [ "$pid" = "$$" ] && continue
+  kill "$pid" 2>/dev/null && killed=$((killed + 1))
+done
+
+if [ "$killed" -gt 0 ]; then
+  log "deadline reached; freed the chip (killed $killed chain processes)"
+else
+  log "deadline reached; chip already free"
+fi

@@ -57,6 +57,19 @@ def main() -> None:
                          "scale — the non-block Koh&Liang path")
     ap.add_argument("--hvp_batch", type=int, default=1 << 20,
                     help="rows per chunk of the full-space HVP scan")
+    ap.add_argument("--cg_maxiter", type=int, default=10,
+                    help="full-space CG iteration cap (10 = the r3 "
+                         "probe; 100 = the reference's fmin_ncg cap)")
+    ap.add_argument("--stream", choices=["zipf", "cal"], default="zipf",
+                    help="train synthesis: r1 Zipf or the cal2-style "
+                         "calibrated stream (waterfilled degrees, "
+                         "unique pairs; Zipf item marginal — no "
+                         "reference split exists at this scale)")
+    ap.add_argument("--users", type=int, default=None,
+                    help="override the ML-20M user count (e.g. ML-1M "
+                         "scale for a converged full-space row)")
+    ap.add_argument("--items", type=int, default=None)
+    ap.add_argument("--rows", type=int, default=None)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--coordinator", type=str, default=None,
                     help="coordinator address for multi-host runs "
@@ -91,6 +104,9 @@ def main() -> None:
     else:
         users, items, rows = 138_493, 26_744, 20_000_263  # ML-20M stats
         steps, n_q, batch = args.train_steps, args.num_queries, args.batch_size
+    users = args.users or users
+    items = args.items or items
+    rows = args.rows or rows
 
     k = args.embed_size
     print(f"stress: {users} users x {items} items, {rows} rows, k={k}, "
@@ -98,9 +114,20 @@ def main() -> None:
           file=sys.stderr, flush=True)
 
     t0 = time.perf_counter()
-    train = synthesize_ratings(users, items, rows, seed=args.seed)
+    if args.stream == "cal":
+        from fia_tpu.data.synthetic import synthesize_calibrated
+
+        # min_degree 16 (ML-20M's source filter is >=20 ratings/user;
+        # 16 matches the ML-1M-ex convention after leave-4-out) unless
+        # the mean degree is too small for it (smoke shapes)
+        min_deg = min(16, max(1, rows // users - 1))
+        train = synthesize_calibrated(users, items, rows, heldout_x=None,
+                                      seed=args.seed, min_degree=min_deg)
+    else:
+        train = synthesize_ratings(users, items, rows, seed=args.seed)
     gen_s = time.perf_counter() - t0
-    print(f"stress: synthesized in {gen_s:.1f}s", file=sys.stderr, flush=True)
+    print(f"stress: synthesized ({args.stream}) in {gen_s:.1f}s",
+          file=sys.stderr, flush=True)
 
     model = MF(users, items, k, weight_decay=1e-3)
     params = model.init_params(jax.random.PRNGKey(args.seed))
@@ -153,6 +180,7 @@ def main() -> None:
             "devices": jax.device_count(),
             "model_parallel": args.model_parallel,
             "users": users, "items": items, "train_rows": rows,
+            "train_stream": args.stream,
             "train_step_ms": round(step_ms, 3),
             "queries_per_sec": round(timing.queries_per_sec, 2),
             "per_query_ms": round(timing.per_query_ms, 3),
@@ -168,24 +196,44 @@ def main() -> None:
 
         fe = FullInfluenceEngine(
             model, state.params, train, damping=1e-4, solver="cg",
-            cg_maxiter=10, hvp_batch=args.hvp_batch, mesh=mesh,
+            cg_maxiter=args.cg_maxiter, hvp_batch=args.hvp_batch,
+            mesh=mesh,
         )
         print(f"stress: full-space probe ({fe.num_params} params, "
-              f"{fe.num_train} rows, hvp_batch={fe.hvp_batch})",
+              f"{fe.num_train} rows, hvp_batch={fe.hvp_batch}, "
+              f"cg_maxiter={args.cg_maxiter})",
               file=sys.stderr, flush=True)
+        # the same v -> solve -> score-all pipeline
+        # get_influence_on_test_prediction runs, staged here so the
+        # residual (one extra chunked HVP + compile) reuses the solve
+        # and is timed OUTSIDE the probe window — 'e2e_incl_compile_s'
+        # must stay comparable with the r3 row that had no residual
+        import numpy as _np
+
         t0 = time.perf_counter()
-        fs_scores = fe.get_influence_on_test_prediction(points[:1])
+        v = fe._pred_grad_jit(fe._flat0, _np.asarray(points[:1]))
+        ihvp = fe.get_inverse_hvp(v)
+        fs_scores = fe._fetch(
+            fe._score_all(ihvp, fe._flat0, fe.train_x, fe.train_y)
+        )
         fs_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        fs_res = fe.relative_residual(v, ihvp)
+        res_s = time.perf_counter() - t0
         out["details"]["full_space"] = {
             "num_params": fe.num_params,
-            "cg_maxiter": 10,
+            "cg_maxiter": args.cg_maxiter,
             "hvp_batch": fe.hvp_batch,
             # first call compiles the CG-over-scan program; one probe run
             # only, so report the honest end-to-end figure
             "e2e_incl_compile_s": round(fs_s, 2),
             "finite": bool(np.isfinite(fs_scores).all()),
+            # ‖Hx−v‖/‖v‖ — the solve-quality number the r3 probe lacked
+            "rel_residual": round(fs_res, 6),
+            "residual_extra_s": round(res_s, 2),
         }
-        print(f"stress: full-space query in {fs_s:.1f}s (incl. compile)",
+        print(f"stress: full-space query in {fs_s:.1f}s (incl. compile); "
+              f"rel residual {fs_res:.2e} (+{res_s:.1f}s)",
               file=sys.stderr, flush=True)
     log.log("query_batch", **timing.json())
     log.log("run_done", value=out["value"])

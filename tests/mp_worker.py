@@ -74,9 +74,18 @@ def main() -> None:
     assert got == x.astype(np.int64).sum()
 
     engine = InfluenceEngine(model, params, train, damping=1e-3,
-                             mesh=mesh, shard_tables=True)
+                             mesh=mesh, shard_tables=True, impl="padded")
     pts = np.array([[3, 5], [0, 1], [7, 2], [11, 9]], np.int32)
     res = engine.query_batch(pts, pad_to=args.pad_to)
+
+    # flat path across processes (r4): the packed segment-sum program
+    # with per-device partial Hessians, one psum, and a process
+    # allgather on the packed outputs — must agree with the padded run
+    flat_eng = InfluenceEngine(model, params, train, damping=1e-3,
+                               mesh=mesh, shard_tables=True, impl="flat")
+    assert flat_eng._flat_eligible(), "flat must be eligible multi-host"
+    flat_res = flat_eng.query_batch(pts, pad_to=args.pad_to)
+    assert np.array_equal(flat_res.counts, res.counts)
 
     # full-parameter engine over the same cross-process mesh: train rows
     # shard over 'data' (chunked HVP), params replicated, result
@@ -90,8 +99,13 @@ def main() -> None:
     assert full_scores.shape[0] == full.num_train
 
     if args.process_id == 0:
+        flat_padded = np.zeros_like(res.scores)
+        for t in range(len(pts)):
+            s = flat_res.scores_of(t)
+            flat_padded[t, : len(s)] = s
         np.savez(args.out, scores=res.scores, counts=res.counts,
-                 full_scores=full_scores)
+                 flat_scores=flat_padded, flat_ihvp=flat_res.ihvp,
+                 padded_ihvp=res.ihvp, full_scores=full_scores)
     print(f"worker {args.process_id}: ok", flush=True)
 
 

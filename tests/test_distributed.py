@@ -210,6 +210,15 @@ class TestTwoProcess:
                 got["scores"][t, : base.counts[t]], base.scores_of(t),
                 rtol=1e-4, atol=1e-6,
             )
+            # the multi-host FLAT path (r4: packed segment-sum with a
+            # process allgather) must agree with the single-process
+            # reference too
+            np.testing.assert_allclose(
+                got["flat_scores"][t, : base.counts[t]], base.scores_of(t),
+                rtol=1e-3, atol=1e-5,
+            )
+        np.testing.assert_allclose(got["flat_ihvp"], got["padded_ihvp"],
+                                   rtol=1e-3, atol=1e-5)
         # full-parameter engine across processes == single-process run
         from fia_tpu.influence.full import FullInfluenceEngine
 

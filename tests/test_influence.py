@@ -376,6 +376,60 @@ class TestEngine:
                 flat.scores_of(t), padded.scores_of(t), rtol=1e-3, atol=1e-5
             )
 
+    def test_flat_accum_variants_agree(self, model_cls):
+        """The one-hot-matmul segment reduction (the TPU MXU form) is a
+        pure implementation knob — it must reproduce the scatter-add
+        scan to fp32 reorder tolerance, including the bilinear
+        cross-term case (a training pair queried directly)."""
+        model, params, train = _setup(model_cls)
+        pair = tuple(train.x[0])
+        pts = np.array([[3, 5], pair, [0, 1]], np.int32)
+        scan = InfluenceEngine(model, params, train, damping=DAMP,
+                               impl="flat",
+                               flat_accum="scan").query_batch(pts)
+        oh = InfluenceEngine(model, params, train, damping=DAMP,
+                             impl="flat",
+                             flat_accum="onehot").query_batch(pts)
+        np.testing.assert_allclose(oh.ihvp, scan.ihvp, rtol=1e-4,
+                                   atol=1e-6)
+        for t in range(len(pts)):
+            np.testing.assert_allclose(
+                oh.scores_of(t), scan.scores_of(t), rtol=1e-4, atol=1e-6
+            )
+
+    def test_flat_stage_prefixes_are_consistent(self, model_cls):
+        """The staged flat programs (roofline instrumentation) are true
+        prefixes: each stage's outputs match the full program's
+        intermediates recomputed from the final outputs' inputs."""
+        import jax.numpy as jnp
+
+        model, params, train = _setup(model_cls)
+        pts = np.array([[3, 5], [0, 1]], np.int32)
+        eng = InfluenceEngine(model, params, train, damping=DAMP,
+                              impl="flat")
+        from fia_tpu.data.index import bucketed_pad
+
+        s_pad = bucketed_pad(
+            int(eng.index.counts_batch(pts).sum()), 2048
+        )
+        args = (eng.params, eng.train_x, eng.train_y, eng._postings,
+                jnp.asarray(pts, jnp.int32))
+        ihvp_s, v_s = eng._flat_fn(s_pad, stage="solve")(*args)
+        H = eng._flat_fn(s_pad, stage="hessian")(*args)
+        g, e = eng._flat_fn(s_pad, stage="grads")(*args)
+        full = eng.query_batch(pts)
+        np.testing.assert_allclose(np.asarray(ihvp_s), full.ihvp,
+                                   rtol=1e-5, atol=1e-7)
+        np.testing.assert_allclose(np.asarray(v_s), full.test_grad,
+                                   rtol=1e-5, atol=1e-7)
+        # the staged Hessian solves to the same ihvp it shipped
+        x = np.linalg.solve(
+            np.asarray(H), np.asarray(v_s)[..., None]
+        )[..., 0]
+        np.testing.assert_allclose(x, full.ihvp, rtol=1e-4, atol=1e-6)
+        assert np.asarray(g).shape == (s_pad, model.block_size)
+        assert np.all(np.isfinite(np.asarray(e)))
+
     def test_flat_chunk_is_inert(self, model_cls):
         """The Hessian-accumulation chunk size is a pure performance
         knob — results must not depend on it."""
@@ -587,6 +641,98 @@ class TestAdaptiveChunking:
         with pytest.raises(RuntimeError, match="unrelated"):
             eng.query_batch(self.PTS)
 
+    def test_transient_tunnel_fault_retries_same_size(self, model_cls):
+        """A single ambiguous tunnel-500 must cost one same-size retry,
+        not a halved re-dispatch — and must teach the envelope nothing
+        (r3 advisor: one flaky 500 degraded every later batch)."""
+        model, params, train = _setup(model_cls)
+        eng = InfluenceEngine(model, params, train, damping=DAMP,
+                              impl="padded")
+        real = eng._query_padded
+        calls = []
+
+        def flaky(test_points, pad_to):
+            calls.append(len(test_points))
+            if len(calls) == 1:
+                raise RuntimeError(
+                    "INTERNAL: HTTP 500: tpu_compile_helper subprocess "
+                    "exit code 1"
+                )
+            return real(test_points, pad_to)
+
+        eng._query_padded = flaky
+        res = eng.query_batch(self.PTS)
+        assert calls == [len(self.PTS)] * 2  # retried at full size
+        assert len(res.counts) == len(self.PTS)
+        assert eng._cells_bad == 1 << 62  # no false ceiling learned
+
+    def test_ambiguous_ceiling_is_not_persisted(self, model_cls,
+                                                tmp_path, monkeypatch):
+        """Two consecutive tunnel-500s at one size do chunk the batch
+        in-process, but the ceiling must stay engine-local — the shared
+        cache min-merge would otherwise never forget a transient."""
+        from fia_tpu.utils import memlimits
+
+        monkeypatch.setenv("FIA_MEMLIMIT_CACHE",
+                           str(tmp_path / "m.json"))
+        eng, calls = self._fake_oom_engine(
+            model_cls,
+            msg="INTERNAL: HTTP 500: tpu_compile_helper subprocess "
+                "exit code 1",
+        )
+        res = eng.query_batch(self.PTS)
+        assert len(res.counts) == len(self.PTS)
+        assert eng._cells_bad < (1 << 62)  # learned in-process...
+        assert eng._cells_bad_hard == 1 << 62
+        ok, bad = memlimits.load(eng._memkey)
+        assert bad == 1 << 62  # ...but never persisted
+        assert ok > 0  # successes still shared
+
+    def test_definite_oom_ceiling_is_persisted(self, model_cls,
+                                               tmp_path, monkeypatch):
+        from fia_tpu.utils import memlimits
+
+        monkeypatch.setenv("FIA_MEMLIMIT_CACHE",
+                           str(tmp_path / "m.json"))
+        eng, _ = self._fake_oom_engine(model_cls)  # RESOURCE_EXHAUSTED
+        eng.query_batch(self.PTS)
+        assert eng._cells_bad_hard < (1 << 62)
+        ok, bad = memlimits.load(eng._memkey)
+        assert bad < (1 << 62)
+
+    def test_ambiguous_fault_cannot_shadow_hard_ceiling(self, model_cls,
+                                                        tmp_path,
+                                                        monkeypatch):
+        """A genuine OOM at a large size followed by tunnel-500s at a
+        smaller size: the hard ceiling must still reach the cache (the
+        single (bad, definite) pair of the first r4 draft lost it)."""
+        from fia_tpu.utils import memlimits
+
+        monkeypatch.setenv("FIA_MEMLIMIT_CACHE",
+                           str(tmp_path / "m.json"))
+        model, params, train = _setup(model_cls)
+        eng = InfluenceEngine(model, params, train, damping=DAMP,
+                              impl="padded")
+        real = eng._query_padded
+
+        def fake(test_points, pad_to):
+            n = len(test_points)
+            if n == len(self.PTS):
+                raise RuntimeError("RESOURCE_EXHAUSTED: fake OOM")
+            if n > 1:
+                raise RuntimeError(
+                    "INTERNAL: HTTP 500: tpu_compile_helper subprocess "
+                    "exit code 1"
+                )
+            return real(test_points, pad_to)
+
+        eng._query_padded = fake
+        res = eng.query_batch(self.PTS)
+        assert len(res.counts) == len(self.PTS)
+        assert eng._cells_bad < eng._cells_bad_hard < (1 << 62)
+        ok, bad = memlimits.load(eng._memkey)
+        assert bad == eng._cells_bad_hard  # hard ceiling persisted
+
     def test_concat_dense_branch(self, model_cls):
         from fia_tpu.influence.engine import InfluenceResult, _concat_results
 
@@ -721,3 +867,67 @@ class TestMemlimitsPersistence:
                            "/nonexistent-fia-test/m.json")
         memlimits.update("k", 1, 2)  # must not raise
         assert memlimits.load("k") == (0, 1 << 62)
+
+    def test_clear_bad_drops_only_contradicted_ceilings(self, tmp_path,
+                                                        monkeypatch):
+        """clear_bad_at: a success at/above the stored failing size
+        drops it; a success still below it leaves the ceiling standing
+        — even when the stored cells_ok is stale-huge (a poisoned ok
+        must not launder away a genuine ceiling)."""
+        from fia_tpu.utils import memlimits
+
+        monkeypatch.setenv("FIA_MEMLIMIT_CACHE",
+                           str(tmp_path / "m.json"))
+        memlimits.update("k", 100, 1000)
+        memlimits.update("k", 500, 1 << 62, clear_bad_at=500)  # below
+        assert memlimits.load("k") == (500, 1000)
+        # stale-huge stored ok + observed success below the ceiling:
+        # the ceiling must survive (comparison point is the observed
+        # success size, not the merged ok)
+        memlimits.update("k", 10_000_000, 1000)
+        memlimits.update("k", 600, 1 << 62, clear_bad_at=600)
+        assert memlimits.load("k") == (10_000_000, 1000)
+        memlimits.update("k", 1000, 1 << 62, clear_bad_at=1000)  # at bad
+        assert memlimits.load("k") == (10_000_000, 1 << 62)
+
+    def test_clear_bad_keeps_relearned_ceiling(self, tmp_path,
+                                               monkeypatch):
+        """One run can clear a stale ceiling AND re-learn a genuine OOM
+        at the same size; the clear must apply to the stored value
+        only, not wipe the caller's newer cells_bad (r4 review)."""
+        from fia_tpu.utils import memlimits
+
+        monkeypatch.setenv("FIA_MEMLIMIT_CACHE",
+                           str(tmp_path / "m.json"))
+        memlimits.update("k", 0, 4096)  # stale ceiling
+        memlimits.update("k", 2048, 4096, clear_bad_at=4096)
+        assert memlimits.load("k") == (2048, 4096)  # re-learned, kept
+
+    def test_contradicted_cached_ceiling_self_heals(self, tmp_path,
+                                                    monkeypatch):
+        """A stale tiny ceiling in the shared cache (the r3 advisor's
+        poisoning scenario, pre-fix caches in the wild): the first
+        dispatch that succeeds at/above it clears it in-process AND in
+        the cache, so later engines run unchunked again."""
+        import jax as _jax
+
+        from fia_tpu.utils import memlimits
+
+        monkeypatch.setenv("FIA_MEMLIMIT_CACHE",
+                           str(tmp_path / "m.json"))
+        model, params, train = _setup(MF)
+        eng = InfluenceEngine(model, params, train, damping=DAMP,
+                              impl="padded")
+        d = int(model.flatten_block(
+            model.extract_block(params, 0, 0)).size)
+        k = memlimits.key(_jax.default_backend(), 1, "model", d)
+        memlimits.update(k, 0, 64)  # poisoned: tiny recorded ceiling
+        res = eng.query_batch(self.PTS)  # chunk=1 dispatches exceed 64
+        assert len(res.counts) == len(self.PTS)
+        assert eng._cells_bad == 1 << 62  # cleared in-process
+        ok, bad = memlimits.load(k)
+        assert bad == 1 << 62 and ok > 64  # cleared in the cache
+
+        fresh, calls = self._engine(limit=len(self.PTS))
+        fresh.query_batch(self.PTS)
+        assert calls[0] == len(self.PTS)  # unchunked again
