@@ -152,7 +152,8 @@ def main():
                 int(engine.index.counts_batch(points).sum()), 2048
             )
             split_args = (engine.params, engine.train_x, engine.train_y,
-                          engine._postings, jnp.asarray(points, jnp.int32))
+                          engine._postings, jnp.asarray(points, jnp.int32),
+                          engine._rowfeat)
             stages = ("grads", "hessian", "solve", "scores")
             fns = {}
             for st in stages:

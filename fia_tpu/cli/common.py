@@ -98,6 +98,12 @@ def base_parser(desc: str) -> argparse.ArgumentParser:
     p.add_argument("--synth_items", type=int, default=400)
     p.add_argument("--synth_train", type=int, default=50_000)
     p.add_argument("--synth_test", type=int, default=500)
+    p.add_argument("--synth_stream", choices=["zipf", "cal"],
+                   default="zipf",
+                   help="synthetic train stream: 'zipf' (r1 generator) "
+                        "or 'cal' (cal2-style waterfilled unique pairs "
+                        "— scales with no reference heldout, e.g. "
+                        "ML-20M fidelity rows)")
     return p
 
 
@@ -172,6 +178,17 @@ def apply_backend(args) -> None:
 
 def load_splits(args):
     if args.dataset == "synthetic":
+        if getattr(args, "synth_stream", "zipf") == "cal":
+            from fia_tpu.data.synthetic import calibrated_splits
+
+            splits = calibrated_splits(
+                args.synth_users, args.synth_items, args.synth_train,
+                args.synth_test, seed=args.seed,
+            )
+            # tag checkpoints so a cal-stream run never loads a
+            # Zipf-stream checkpoint (and vice versa)
+            args._synth_tag = "calsynth"
+            return splits
         return synthetic_splits(
             args.synth_users, args.synth_items, args.synth_train,
             args.synth_test, seed=args.seed,

@@ -337,6 +337,51 @@ def sample_heldout_pairs(
     return np.asarray(pts, dtype=np.int32)
 
 
+def calibrated_splits(
+    num_users: int,
+    num_items: int,
+    num_train: int,
+    num_test: int,
+    seed: int = 0,
+    min_degree: int = 16,
+    rank: int = 8,
+    noise: float = 0.4,
+) -> dict[str, RatingDataset]:
+    """Train/valid/test splits on the cal2-style calibrated stream at
+    scales with NO reference heldout files (ML-20M stress — r4).
+
+    Train comes from :func:`synthesize_calibrated` (waterfilled unique
+    pairs, Zipf item marginal); valid/test pairs are sampled DISJOINT
+    from train (:func:`sample_heldout_pairs`) and rated by the SAME
+    planted model as the train split: ``_planted_ratings`` draws the
+    planted factors from its rng before any row-dependent consumption,
+    so re-seeding ``seed + 1`` reproduces them exactly (only the
+    per-row noise differs — as it should).
+    """
+    min_degree = min(min_degree, max(1, num_train // num_users - 1))
+    train = synthesize_calibrated(
+        num_users, num_items, num_train, heldout_x=None, seed=seed,
+        min_degree=min_degree, rank=rank, noise=noise,
+    )
+    # checkpoint/cache names key on this tag (cli/common.py
+    # model_name_for): a cal-stream run must never resume from or
+    # share an influence cache with a Zipf-stream checkpoint
+    train.synth_tag = "calsynth"
+    pts = sample_heldout_pairs(
+        train.x, num_users, num_items, 2 * num_test, seed=seed + 17
+    )
+    y = _planted_ratings(
+        pts[:, 0].astype(np.int64), pts[:, 1].astype(np.int64),
+        num_users, num_items, np.random.default_rng(seed + 1),
+        rank=rank, noise=noise,
+    )
+    return {
+        "train": train,
+        "validation": RatingDataset(pts[:num_test], y[:num_test]),
+        "test": RatingDataset(pts[num_test:], y[num_test:]),
+    }
+
+
 def synthetic_splits(
     num_users: int,
     num_items: int,

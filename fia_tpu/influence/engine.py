@@ -135,6 +135,18 @@ def _classify_device_failure(e: Exception) -> str | None:
         return "oom"
     if "tpu_compile_helper subprocess exit code" in s:
         return "ambiguous"
+    if (
+        "worker process crashed or restarted" in s
+        or "kernel fault" in s
+        or ("UNAVAILABLE" in s and "TPU worker" in s)
+    ):
+        # The r3 k=256 failure mode: the TPU worker process died at
+        # RUNTIME (not an XLA OOM — those fail at compile). Observed at
+        # 64-query k=256 batches whose (chunk, 514, 514) accumulation
+        # buffer reached 2.2 GB. Every device buffer this client held
+        # is gone; recovery needs a device-state rebuild plus a
+        # smaller dispatch (engine._reset_device_state + retry-at-half).
+        return "worker"
     return None
 
 
@@ -211,49 +223,41 @@ class InfluenceEngine:
         impl: str = "auto",
         flat_chunk: int = 2048,
         flat_accum: str = "auto",
+        row_features: str = "auto",
     ):
         if solver not in ("direct", "cg", "lissa", "schulz"):
             raise ValueError(f"unknown solver {solver!r}")
         self.model = model
-        self.params = jax.tree_util.tree_map(jnp.asarray, params)
-        if shard_tables:
-            if mesh is None or "model" not in mesh.axis_names:
-                raise ValueError("shard_tables requires a mesh with a 'model' axis")
-            from fia_tpu.parallel.sharded import shard_model_params
-
-            self.params = shard_model_params(mesh, self.params, model)
-        self.train_x = jnp.asarray(train.x)
-        self.train_y = jnp.asarray(train.y)
-        # host view kept for the cache fingerprint (zero-copy refs)
+        if shard_tables and (mesh is None or "model" not in mesh.axis_names):
+            raise ValueError("shard_tables requires a mesh with a 'model' axis")
+        # Fused per-train-row feature table for the flat path (see
+        # models/base.py hook doc). Chip A/Bs (roofline --ab feat,
+        # output/roofline_{mf,ncf}_feat*.json, r4) measured it a WASH
+        # on both models once the block_row_grads hook and the
+        # single-gather row construction landed — the per-dispatch
+        # gathers it fuses were no longer the binding traffic — so
+        # 'auto' resolves to OFF (no HBM spent on a neutral cache);
+        # 'on' forces the table (gated to models defining the hooks,
+        # ids < 2^24 for exact float-packed comparison, and a 2 GB
+        # physical budget — the minor axis tiles to a 128 multiple).
+        if row_features not in ("auto", "on", "off"):
+            raise ValueError(f"unknown row_features {row_features!r}")
+        self.row_features = row_features
+        self._rowfeat = None
+        # Host copies survive a TPU worker crash/restart (the r3 k=256
+        # failure mode kills every device buffer this client holds);
+        # _upload_device_state rebuilds the device state from them.
+        self._params_host = jax.tree_util.tree_map(np.asarray, params)
         self._train_host = (np.asarray(train.x), np.asarray(train.y))
+        self._shard_tables = shard_tables
+        self.mesh = mesh
         self._multihost = False
         if mesh is not None:
-            # On a cross-process (multi-host) mesh every jit operand must
-            # be a global array; params (unless already table-sharded
-            # above) and train tensors are replicated. No-op single-host.
-            from fia_tpu.parallel.distributed import put_global, spans_processes
+            from fia_tpu.parallel.distributed import spans_processes
 
-            if spans_processes(mesh):
-                self._multihost = True
-                if not shard_tables:
-                    self.params = put_global(mesh, self.params, P())
-                self.train_x = put_global(mesh, self.train_x, P())
-                self.train_y = put_global(mesh, self.train_y, P())
+            self._multihost = spans_processes(mesh)
         self.index = InteractionIndex(train.x, model.num_users, model.num_items)
-        # CSR postings live on device: related sets are gathered inside
-        # the jitted query, so per-batch host→device traffic is just the
-        # (T, 2) test points — not (T, P) padded index/mask arrays, whose
-        # transfer dominated end-to-end latency on tunnel/PCIe-attached
-        # hosts (measured 1.2 s of a 1.36 s 256-query batch at P=3584).
-        self._postings = tuple(
-            jnp.asarray(a, jnp.int32) for a in self.index.postings()
-        )
-        if self._multihost:
-            from fia_tpu.parallel.distributed import put_global
-
-            self._postings = tuple(
-                put_global(mesh, a, P()) for a in self._postings
-            )
+        self._upload_device_state()
         self.damping = float(damping)
         self.solver = solver
         self.cg_maxiter = int(cg_maxiter)
@@ -310,6 +314,18 @@ class InfluenceEngine:
         # steps at more VMEM/HBM (2048 ~ 9.5 MB at d=34). Rounded down to
         # a power of two so it always divides the power-of-two S pad.
         self.flat_chunk = 1 << max(0, int(flat_chunk).bit_length() - 1)
+        # d-aware clamp: the accumulation buffer is (chunk, d, d) — at
+        # k=256 (d=514) the default 2048-chunk makes it 2.2 GB, which
+        # crashed the TPU worker at RUNTIME twice in r3 (RQ2 k=256,
+        # "kernel fault", not an XLA OOM). Cap chunk at the largest
+        # power of two keeping the buffer <= 64M fp32 elements (256 MB)
+        # — no floor: flooring at 128 would re-cross the crash size
+        # for blocks beyond d≈707. d=34/64 reference blocks are
+        # untouched (cap >> 2048).
+        d_blk = int(model.block_size)
+        cap_elems = 64_000_000 // max(d_blk * d_blk, 1)
+        cap = 1 << max(0, cap_elems.bit_length() - 1) if cap_elems else 1
+        self.flat_chunk = max(1, min(self.flat_chunk, cap))
         # Flat-path per-query Hessian segment reduction: 'scan' is the
         # scatter-add form (VPU serial, memory-lean), 'onehot' the
         # (T, chunk) @ (chunk, d²) matmul form (MXU; chip A/B winner,
@@ -344,6 +360,88 @@ class InfluenceEngine:
         # clears stale cached ceilings <= this size. 0 = none.
         self._cleared_bad = 0
         self._memkey = None
+
+    def _upload_device_state(self) -> None:
+        """(Re)build every device-resident tensor from host copies.
+
+        Called at construction, and again by :meth:`_reset_device_state`
+        after a TPU worker crash. CSR postings live on device: related
+        sets are gathered inside the jitted query, so per-batch
+        host→device traffic is just the (T, 2) test points — not (T, P)
+        padded index/mask arrays, whose transfer dominated end-to-end
+        latency on tunnel/PCIe-attached hosts (measured 1.2 s of a
+        1.36 s 256-query batch at P=3584). On a cross-process mesh
+        every jit operand must be a global array; params (unless
+        table-sharded) and train tensors are replicated.
+        """
+        mesh = self.mesh
+        self.params = jax.tree_util.tree_map(jnp.asarray, self._params_host)
+        if self._shard_tables:
+            from fia_tpu.parallel.sharded import shard_model_params
+
+            self.params = shard_model_params(mesh, self.params, self.model)
+        self.train_x = jnp.asarray(self._train_host[0])
+        self.train_y = jnp.asarray(self._train_host[1])
+        self._postings = tuple(
+            jnp.asarray(a, jnp.int32) for a in self.index.postings()
+        )
+        self._rowfeat = None
+        if self._want_row_features():
+            x, y = self._train_host
+            step = 1 << 21  # bound the build's activation peak
+            parts = [
+                self.model.build_row_features(
+                    self.params, jnp.asarray(x[s: s + step], jnp.int32),
+                    jnp.asarray(y[s: s + step]),
+                )
+                for s in range(0, len(x), step)
+            ]
+            self._rowfeat = (
+                parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+            )
+        if self._multihost:
+            from fia_tpu.parallel.distributed import put_global
+
+            if not self._shard_tables:
+                self.params = put_global(mesh, self.params, P())
+            self.train_x = put_global(mesh, self.train_x, P())
+            self.train_y = put_global(mesh, self.train_y, P())
+            self._postings = tuple(
+                put_global(mesh, a, P()) for a in self._postings
+            )
+            if self._rowfeat is not None:
+                self._rowfeat = put_global(mesh, self._rowfeat, P())
+
+    def _want_row_features(self) -> bool:
+        if (
+            self.model.build_row_features is None
+            or self.row_features == "off"
+            # table-sharded params: the fused table would replicate what
+            # sharding just split — defeats the point at those scales
+            or self._shard_tables
+        ):
+            return False
+        if max(self.model.num_users, self.model.num_items) >= (1 << 24):
+            return False  # float-packed ids must compare exactly
+        if self.row_features != "on":
+            return False  # 'auto' = off: measured neutral (chip A/B r4)
+        n = len(self._train_host[0])
+        padded = -(-int(self.model.row_feature_dim) // 128) * 128
+        # 2 GB physical budget: (N, F) stores as (N, ceil(F/128)*128)
+        return n * padded * 4 <= (2 << 30)
+
+    def _reset_device_state(self) -> None:
+        """Recover from a TPU worker crash/restart ("kernel fault").
+
+        Every device buffer this client held (params, train tensors,
+        postings, in-flight outputs) died with the worker, and compiled
+        executables bound to the dead client state cannot be trusted —
+        drop them and re-upload. Host-side state (index, learned memory
+        envelope, result caches) survives untouched, so recovery costs
+        one re-upload plus recompiles of whatever runs next.
+        """
+        self._jitted.clear()
+        self._upload_device_state()
 
     # -- the pure per-test-point query ------------------------------------
     def _query_one(self, params, train_x, train_y, postings, u, i, test_x,
@@ -441,7 +539,8 @@ class InfluenceEngine:
         full program. Stages are cumulative prefixes of one program, so
         best-of-N time differences attribute device cost per stage.
         """
-        key = ("flat", s_pad, stage)
+        use_feat = self._rowfeat is not None
+        key = ("flat", s_pad, stage, use_feat)
         if key in self._jitted:
             return self._jitted[key]
         if stage not in ("grads", "hessian", "solve", "scores"):
@@ -477,7 +576,7 @@ class InfluenceEngine:
                     )
                 )
 
-        def fn(params, train_x, train_y, postings, tx):
+        def fn(params, train_x, train_y, postings, tx, rowfeat):
             T = tx.shape[0]
             u, i = tx[:, 0], tx[:, 1]
             uoff, urows, ioff, irows = postings
@@ -490,45 +589,82 @@ class InfluenceEngine:
             total = off[-1]
 
             s = jnp.arange(s_pad, dtype=jnp.int32)
-            t = jnp.clip(jnp.searchsorted(off, s, side="right") - 1, 0, T - 1)
+            # segment ids by scatter+cumsum, not searchsorted: the
+            # binary search lowers to ~log2(T) serialized S-wide gather
+            # rounds, the scan to one T-element scatter + one VPU
+            # cumsum. Duplicate offsets (empty segments) accumulate in
+            # the scatter and the cumsum skips them correctly.
+            t = jnp.clip(
+                jnp.cumsum(
+                    jnp.zeros((s_pad,), jnp.int32)
+                    .at[off[1:T]]
+                    .add(1, mode="drop")
+                ),
+                0, T - 1,
+            )
             pos = s - off[t]
             valid = s < total
             ut, it = u[t], i[t]
-            row = jnp.where(
+            # ONE flat-row gather from the concatenated postings (item
+            # lists offset past the user lists) instead of gathering
+            # both lists and selecting — halves the dominant random-
+            # access traffic of the row construction
+            cat_rows = jnp.concatenate([urows, irows])
+            base = jnp.where(
                 pos < nu[t],
-                urows[jnp.clip(uoff[ut] + pos, 0, urows.shape[0] - 1)],
-                irows[jnp.clip(ioff[it] + pos - nu[t], 0, irows.shape[0] - 1)],
+                uoff[ut] + pos,
+                urows.shape[0] + ioff[it] + pos - nu[t],
             )
+            row = cat_rows[jnp.clip(base, 0, cat_rows.shape[0] - 1)]
             if mesh is not None:
                 # shard the flat row axis: the gather, gradient vmap and
                 # Hessian accumulation below all split across devices
                 row, t, pos, valid = (c(a) for a in (row, t, pos, valid))
                 ut, it = c(u[t]), c(i[t])
-            rel_x = train_x[row]
-            rel_y = train_y[row]
             wv = valid.astype(jnp.float32)
 
-            # per-flat-row prediction gradients w.r.t. the owning query's
-            # block (the J of the Gauss-Newton form)
-            def one_g(xj, uu, ii):
-                block0 = model.extract_block(params, uu, ii)
+            # Per-flat-row prediction gradients w.r.t. the owning
+            # query's block (the J of the Gauss-Newton form), residual
+            # e, and the user/item match masks. Three tiers, fastest
+            # first:
+            #  - fused row-feature table: ONE wide gather; every other
+            #    per-row gather reads a full (8, 128) tile for <=16
+            #    useful values — XLA's cost model put the multi-gather
+            #    grads stage at 39 GB accessed vs ~1.5 GB fused
+            #    (output/roofline_mf.json, r4)
+            #  - block_row_grads hook: one batched program over
+            #    gathered inputs
+            #  - vmapped autodiff: S single-row graphs; measured 92% of
+            #    MF flat-query device time (BENCH r4 device_split)
+            if use_feat:
+                feat = rowfeat[row]
+                g, e, ma, mb = model.grads_from_row_features(feat, ut, it)
+                ab = wv * ma * mb
+            else:
+                rel_x = train_x[row]
+                rel_y = train_y[row]
+                if model.block_row_grads is not None:
+                    g = model.block_row_grads(params, ut, it, rel_x)
+                else:
+                    def one_g(xj, uu, ii):
+                        block0 = model.extract_block(params, uu, ii)
 
-                def pred(bvec):
-                    block = model.unflatten_block(bvec, block0)
-                    return model.block_predict(
-                        params, block, uu, ii, xj[None, :]
-                    )[0]
+                        def pred(bvec):
+                            block = model.unflatten_block(bvec, block0)
+                            return model.block_predict(
+                                params, block, uu, ii, xj[None, :]
+                            )[0]
 
-                return jax.grad(pred)(model.flatten_block(block0))
+                        return jax.grad(pred)(model.flatten_block(block0))
 
-            g = jax.vmap(one_g)(rel_x, ut, it)  # (S, d)
-            e = model.predict(params, rel_x) - rel_y
+                    g = jax.vmap(one_g)(rel_x, ut, it)  # (S, d)
+                e = model.predict(params, rel_x) - rel_y
+                ab = wv * (rel_x[:, 0] == ut) * (rel_x[:, 1] == it)
             if stage == "grads":
                 return g, e
 
             # H_t = (2/n_t) Σ_{s∈t} w (g gᵀ + a b e C) + diag(reg) + λI,
             # accumulated in chunks so the outer-product buffer stays small
-            ab = wv * (rel_x[:, 0] == ut) * (rel_x[:, 1] == it)
 
             onehot = self.flat_accum == "onehot" or (
                 self.flat_accum == "auto"
@@ -687,7 +823,8 @@ class InfluenceEngine:
 
             tx = put_global(self.mesh, tx, P())
         out = self._flat_fn(s_pad)(
-            self.params, self.train_x, self.train_y, self._postings, tx
+            self.params, self.train_x, self.train_y, self._postings, tx,
+            self._rowfeat,
         )
         pad = bucketed_pad(
             counts.max() if counts.size else 1, self.pad_bucket, pad_to
@@ -699,9 +836,34 @@ class InfluenceEngine:
         return self._assemble_packed(test_points, counts, out, pad)
 
     def _query_flat(
-        self, test_points: np.ndarray, pad_to: int | None = None
+        self,
+        test_points: np.ndarray,
+        pad_to: int | None = None,
+        _depth: int = 0,
     ) -> InfluenceResult:
-        return self._finalize_flat(self._dispatch_flat(test_points, pad_to))
+        try:
+            return self._finalize_flat(
+                self._dispatch_flat(test_points, pad_to)
+            )
+        except Exception as e:
+            T = len(test_points)
+            if (
+                _classify_device_failure(e) != "worker"
+                or _depth >= 3
+                or T <= 1
+            ):
+                raise
+            # Bounded retry-at-half after a TPU worker crash (the r3
+            # k=256 failure: 64-query batches killed the worker twice,
+            # 32 succeeded — BASELINE §4.1). The crash destroyed every
+            # device buffer, so rebuild state first; depth 3 bounds a
+            # persistent fault to ~log2 retries before surfacing.
+            self._reset_device_state()
+            h = (T + 1) // 2
+            return _concat_results([
+                self._query_flat(test_points[:h], pad_to, _depth + 1),
+                self._query_flat(test_points[h:], pad_to, _depth + 1),
+            ])
 
     def query_many(
         self,
@@ -730,13 +892,28 @@ class InfluenceEngine:
         if not (self.impl in ("auto", "flat") and self._flat_eligible()):
             return [self.query_batch(b, pad_to=pad_to) for b in batches]
         results: list[InfluenceResult] = []
-        inflight: list = []
-        for b in batches:
-            inflight.append(self._dispatch_flat(b, pad_to))
-            if len(inflight) >= max(1, window):
+        done = 0  # finalize order == dispatch order == batch order
+        try:
+            inflight: list = []
+            for b in batches:
+                inflight.append(self._dispatch_flat(b, pad_to))
+                if len(inflight) >= max(1, window):
+                    results.append(self._finalize_flat(inflight.pop(0)))
+                    done += 1
+            while inflight:
                 results.append(self._finalize_flat(inflight.pop(0)))
-        while inflight:
-            results.append(self._finalize_flat(inflight.pop(0)))
+                done += 1
+        except Exception as e:
+            if _classify_device_failure(e) != "worker":
+                raise
+            # A worker crash kills every in-flight dispatch at once.
+            # Rebuild device state and run the unfinalized remainder
+            # sequentially through _query_flat, whose own bounded
+            # halving absorbs a recurring crash; already-finalized
+            # results are host numpy and stay valid.
+            self._reset_device_state()
+            for b in batches[done:]:
+                results.append(self._query_flat(b, pad_to))
         return results
 
     def _assemble_packed(self, test_points, counts, out, pad: int) -> InfluenceResult:
@@ -914,7 +1091,8 @@ class InfluenceEngine:
         self._cells_ok = min(self._cells_ok, self._cells_bad // 2)
 
     def _dispatch_padded_resilient(
-        self, test_points: np.ndarray, pad: int | None
+        self, test_points: np.ndarray, pad: int | None,
+        s_pad: int | None = None,
     ) -> InfluenceResult:
         """One padded dispatch; ambiguous tunnel failures retry once.
 
@@ -927,11 +1105,11 @@ class InfluenceEngine:
         the backend just measured as over-memory cannot succeed.
         """
         try:
-            return self._query_padded(test_points, pad)
+            return self._query_padded(test_points, pad, s_pad)
         except Exception as e:
             if _classify_device_failure(e) != "ambiguous":
                 raise
-            return self._query_padded(test_points, pad)
+            return self._query_padded(test_points, pad, s_pad)
 
     def _query_padded_adaptive(
         self, test_points: np.ndarray, pad_to: int | None
@@ -1012,7 +1190,12 @@ class InfluenceEngine:
                 cls = _classify_device_failure(e)
                 if T <= 1 or cls is None:
                     raise
-                self._record_bad(T * pad, cls == "oom")
+                if cls == "worker":
+                    # not memory evidence — rebuild the dead device
+                    # state and halve, teaching the envelope nothing
+                    self._reset_device_state()
+                else:
+                    self._record_bad(T * pad, cls == "oom")
                 chunk = max(1, T // 2)
             else:
                 # Record fast-path successes too: otherwise one
@@ -1021,21 +1204,44 @@ class InfluenceEngine:
                 self._record_ok(T * pad)
                 return out
 
+        # Shared packed-output pad for every chunk of this batch: each
+        # distinct (pad, s) pair is a fresh XLA compile, and letting
+        # every chunk bucket its own total burned one ~7-14 s compile
+        # per chunk per batch on chunked NCF A/B rounds (r4,
+        # output/ab_impls_ncf_r4.log). The sliding-window max bounds
+        # ANY contiguous chunk of the current size, so halving mid-loop
+        # just recomputes it.
+        cum = np.concatenate([[0], np.cumsum(counts, dtype=np.int64)])
+
+        def shared_s(c: int) -> int | None:
+            if self.mesh is not None:
+                return None  # packed output path is single-device only
+            win = int((cum[min(c, T):] - cum[: T - min(c, T) + 1]).max())
+            return bucketed_pad(max(win, 1), 1024)
+
         parts: list[InfluenceResult] = []
         start = 0
+        s_shared = shared_s(chunk)
+        prev_chunk = chunk
         while start < T:
+            if chunk != prev_chunk:
+                s_shared = shared_s(chunk)
+                prev_chunk = chunk
             n = min(chunk, T - start)
             try:
                 parts.append(
                     self._dispatch_padded_resilient(
-                        test_points[start : start + n], pad
+                        test_points[start : start + n], pad, s_shared
                     )
                 )
             except Exception as e:
                 cls = _classify_device_failure(e)
                 if n <= 1 or cls is None:
                     raise
-                self._record_bad(n * pad, cls == "oom")
+                if cls == "worker":
+                    self._reset_device_state()
+                else:
+                    self._record_bad(n * pad, cls == "oom")
                 chunk = max(1, n // 2)
                 continue
             self._record_ok(n * pad)
@@ -1043,9 +1249,15 @@ class InfluenceEngine:
         return parts[0] if len(parts) == 1 else _concat_results(parts)
 
     def _query_padded(
-        self, test_points: np.ndarray, pad_to: int | None
+        self, test_points: np.ndarray, pad_to: int | None,
+        s_pad: int | None = None,
     ) -> InfluenceResult:
-        """One device dispatch at a single pad length."""
+        """One device dispatch at a single pad length.
+
+        ``s_pad``: caller-shared packed-output length (must be >= this
+        batch's related-row total); chunked dispatches of one batch
+        pass a common value so they share one compiled program.
+        """
         counts = self.index.counts_batch(test_points)
         m = counts.max() if counts.size else 1
         if pad_to is None and self.pad_policy == "dataset":
@@ -1077,7 +1289,7 @@ class InfluenceEngine:
             # ≤12.5% padding waste in the packed transfer (vs ~5× above
             # it for the unpacked (T, P) copy).
             total = int(counts.sum())
-            s = bucketed_pad(total, 1024)
+            s = bucketed_pad(total, 1024) if s_pad is None else int(s_pad)
             out = self._batched_packed(pad, s)(
                 self.params, self.train_x, self.train_y, self._postings,
                 u, i, tx,

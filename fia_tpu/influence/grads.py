@@ -67,7 +67,13 @@ def per_example_block_prediction_grads(model, params, u, i, x):
     The Jacobian of the prediction w.r.t. the block — the J in
     Gauss-Newton block-Hessian forms (H = (2/n) Jᵀ W J + corrections),
     exact for models whose prediction is piecewise-linear in the block.
+    Routes through the model's ``block_row_grads`` hook when defined
+    (one batched program instead of B vmapped single-row graphs — see
+    models/base.py hook doc); the autodiff fallback remains the
+    definition the hook is regression-tested against.
     """
+    if model.block_row_grads is not None:
+        return model.block_row_grads(params, u, i, x)
     block0 = model.extract_block(params, u, i)
     bvec0 = model.flatten_block(block0)
 

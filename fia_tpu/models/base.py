@@ -176,6 +176,37 @@ class LatentFactorModel:
     block_cross_const = None
     block_reg_diag = None
 
+    #: optional fused row-feature hooks, one step beyond
+    #: ``block_row_grads``: ``build_row_features(params, x, y) -> (N, F)``
+    #: packs every per-TRAIN-ROW quantity the flat influence program
+    #: needs (the query-independent own-gradient components, the
+    #: residual e_j, and the float-packed row ids) into ONE dense
+    #: table, and ``grads_from_row_features(feat, u, i) ->
+    #: (g (B, d), e (B,), a (B,), b (B,))`` recovers the per-row block
+    #: gradients, residuals, and user/item match masks (``u``/``i``
+    #: scalar or (B,) query ids) with masks only. Why: the flat program's cost is gather-tile traffic — each
+    #: separate embedding/posting gather of a k=16 row reads a full
+    #: (8, 128) TPU tile, and XLA's cost model put the MF grads stage
+    #: at 39 GB accessed (73% of v5e HBM bandwidth) for ~100 MB of
+    #: useful data (roofline_mf.json, r4). One wide gather from the
+    #: fused table replaces ~8 scattered ones. The engine gates the
+    #: table by size (it stores (N, ceil(F/128)·128) physically).
+    build_row_features = None
+    grads_from_row_features = None
+
+    #: optional fast per-row block-Jacobian hook:
+    #: ``block_row_grads(params, u, i, x) -> (B, d)`` with g_j =
+    #: ∇_block r̂(z_j); ``u``/``i`` may be scalars or (B,) arrays aligned
+    #: with ``x`` (the flat engine's per-row query ids). The generic
+    #: path vmaps ``jax.grad`` over B single-row graphs — measured 92%
+    #: of the MF flat query's device time (BENCH r4 device_split,
+    #: 157 ms of 170 ms) for what is closed-form gathers (MF) or one
+    #: batched backward (NCF): each row's prediction touches the query
+    #: block only through its own gathered embeddings, so the stacked
+    #: per-row own-input gradients of sum_j r̂_j, masked by the
+    #: user/item match indicators, ARE the per-row block gradients.
+    block_row_grads = None
+
     def block_loss(self, params: Params, block: Block, u, i, x, y, w=None):
         err = self.indiv_loss_from_pred(
             self.block_predict(params, block, u, i, x), y

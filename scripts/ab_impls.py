@@ -201,7 +201,8 @@ def main():
             fn = eng._flat_fn(s_pad)
             txr = jnp.asarray(p, jnp.int32)
             t0 = time.perf_counter()
-            o = fn(eng.params, eng.train_x, eng.train_y, eng._postings, txr)
+            o = fn(eng.params, eng.train_x, eng.train_y, eng._postings, txr,
+                   eng._rowfeat)
             jax.block_until_ready(o)
             dev.append(time.perf_counter() - t0)
             t0 = time.perf_counter()

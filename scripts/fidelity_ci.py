@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Bootstrap confidence intervals for the RQ1 fidelity rows.
+
+The r3 judge (VERDICT item 3): no fidelity row carries uncertainty, yet
+0.9410-vs-0.9466-grade comparisons are discussed as if resolved. The
+RQ1 driver's npz artifacts (the same layout the reference's RQ1.py
+writes: actual/predicted loss diffs per removal, r3
+`output/RQ1-<model>-<dataset>.npz`) hold every (actual, predicted)
+pair, so the CI is a pure post-processing step — no chip time.
+
+Method: percentile bootstrap on the POOLED Pearson r, resampling
+removals with replacement WITHIN each test point (stratified — the
+protocol fixes 50 removals per point, so resampling must preserve that
+structure), B=10,000 draws. Per-point r and its CI are reported too.
+
+Usage: python scripts/fidelity_ci.py [--npz output/RQ1-*.npz ...]
+Writes output/fidelity_ci.json and prints one summary line per file.
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def pearson(a: np.ndarray, b: np.ndarray) -> float:
+    if len(a) < 2 or np.std(a) == 0 or np.std(b) == 0:
+        return float("nan")
+    return float(np.corrcoef(a, b)[0, 1])
+
+
+def bootstrap_ci(
+    actual, predicted, groups, B=10_000, seed=0, alpha=0.05
+):
+    """(lo, hi) percentile CI of pooled Pearson under stratified
+    resampling of removals within each test point."""
+    rng = np.random.default_rng(seed)
+    uniq = np.unique(groups)
+    idx_of = {g: np.flatnonzero(groups == g) for g in uniq}
+    rs = np.empty(B)
+    for b in range(B):
+        take = np.concatenate([
+            idx_of[g][rng.integers(0, len(idx_of[g]), len(idx_of[g]))]
+            for g in uniq
+        ])
+        rs[b] = pearson(actual[take], predicted[take])
+    rs = rs[np.isfinite(rs)]
+    lo, hi = np.percentile(rs, [100 * alpha / 2, 100 * (1 - alpha / 2)])
+    return float(lo), float(hi)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--npz", nargs="*", default=None)
+    ap.add_argument("--B", type=int, default=10_000)
+    ap.add_argument("--out", default="output/fidelity_ci.json")
+    args = ap.parse_args()
+
+    files = args.npz or sorted(glob.glob("output/RQ1-*.npz"))
+    result = {}
+    for f in files:
+        d = np.load(f)
+        a = np.asarray(d["actual_loss_diffs"], np.float64)
+        p = np.asarray(d["predicted_loss_diffs"], np.float64)
+        g = np.asarray(d["test_index_of_row"])
+        pooled = pearson(a, p)
+        lo, hi = bootstrap_ci(a, p, g, B=args.B)
+        per_point = {}
+        for t in np.unique(g):
+            m = g == t
+            plo, phi = bootstrap_ci(a[m], p[m], g[m], B=args.B,
+                                    seed=int(t) + 1)
+            per_point[int(t)] = {
+                "r": round(pearson(a[m], p[m]), 4),
+                "ci95": [round(plo, 4), round(phi, 4)],
+                "n": int(m.sum()),
+            }
+        entry = {
+            "pooled_r": round(pooled, 4),
+            "pooled_ci95": [round(lo, 4), round(hi, 4)],
+            "n_rows": len(a),
+            "n_points": len(per_point),
+            "per_point": per_point,
+            "bootstrap_draws": args.B,
+        }
+        result[os.path.basename(f)] = entry
+        print(f"{os.path.basename(f)}: pooled r = {pooled:.4f} "
+              f"[{lo:.4f}, {hi:.4f}] over {len(a)} rows / "
+              f"{len(per_point)} points")
+    with open(args.out, "w") as fh:
+        json.dump(result, fh, indent=2)
+
+
+if __name__ == "__main__":
+    main()

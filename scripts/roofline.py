@@ -88,6 +88,11 @@ def main():
     ap.add_argument("--trace", type=str, default=None,
                     help="also dump a jax.profiler trace of one full "
                          "dispatch per accum variant to this dir")
+    ap.add_argument("--ab", choices=["accum", "feat"], default="accum",
+                    help="which implementation pair to A/B in one "
+                         "interleaved run: the Hessian accumulation "
+                         "forms, or the fused row-feature table "
+                         "on/off (both at the onehot accum)")
     args = ap.parse_args()
 
     import jax
@@ -128,12 +133,21 @@ def main():
     ).params
     print("roofline: training done", file=sys.stderr, flush=True)
 
-    engines = {
-        acc: InfluenceEngine(model, params, train, damping=1e-6,
-                             solver="direct", pad_bucket=512,
-                             impl="flat", flat_accum=acc)
-        for acc in ("scan", "onehot")
-    }
+    if args.ab == "feat":
+        engines = {
+            mode: InfluenceEngine(model, params, train, damping=1e-6,
+                                  solver="direct", pad_bucket=512,
+                                  impl="flat", flat_accum="onehot",
+                                  row_features=mode)
+            for mode in ("on", "off")
+        }
+    else:
+        engines = {
+            acc: InfluenceEngine(model, params, train, damping=1e-6,
+                                 solver="direct", pad_bucket=512,
+                                 impl="flat", flat_accum=acc)
+            for acc in ("scan", "onehot")
+        }
 
     B = args.batch_queries
     rounds = min(args.rounds, max(1, len(test_x) // B - 1))
@@ -142,7 +156,7 @@ def main():
     batches = [
         test_x[order[r * B: (r + 1) * B]] for r in range(rounds)
     ]
-    eng0 = engines["scan"]
+    eng0 = next(iter(engines.values()))
     # one shared pad across rounds: each (accum, stage) then compiles
     # exactly once, and every timed dispatch reuses the same program
     s_pad = max(
@@ -152,10 +166,18 @@ def main():
     d = model.block_size
     txs = [jnp.asarray(b, jnp.int32) for b in batches]
 
+    # Null-program baseline: same signature, trivial compute. Its timed
+    # cost is the fixed per-dispatch overhead (RPC + readiness RTT +
+    # probe RTT on the tunnel) that every stage's ABSOLUTE time carries;
+    # subtracting it isolates the first stage's device cost. Stage
+    # DIFFS cancel it already.
+    null_fn = jax.jit(
+        lambda params, tx, ty, postings, t: jnp.sum(t)
+    )
     fns, costs = {}, {}
     for acc, eng in engines.items():
         arg0 = (eng.params, eng.train_x, eng.train_y, eng._postings,
-                txs[0])
+                txs[0], eng._rowfeat)
         for st in STAGES:
             fn = eng._flat_fn(s_pad, stage=st)
             t0 = time.perf_counter()
@@ -170,21 +192,44 @@ def main():
                   file=sys.stderr, flush=True)
 
     times = {k: [] for k in fns}
+    probes = {k: [] for k in fns}
+    null_times = []
     for r in range(rounds):
+        a0 = next(iter(engines.values()))
+        a_null = (a0.params, a0.train_x, a0.train_y, a0._postings,
+                  txs[r])
+        t0 = time.perf_counter()
+        out = null_fn(*a_null)
+        jax.block_until_ready(out)
+        float(out)
+        null_times.append(time.perf_counter() - t0)
         for acc, eng in engines.items():
             a = (eng.params, eng.train_x, eng.train_y, eng._postings,
-                 txs[r])
+                 txs[r], eng._rowfeat)
             for st in STAGES:
                 t0 = time.perf_counter()
-                jax.block_until_ready(fns[acc, st](*a))
-                times[acc, st].append(time.perf_counter() - t0)
+                out = fns[acc, st](*a)
+                jax.block_until_ready(out)
+                t1 = time.perf_counter()
+                # Trust-but-verify on the tunneled backend: fetch ONE
+                # scalar that depends on the outputs. If
+                # block_until_ready returned before the device actually
+                # finished (observed: 4e-5 s "stage times" on a program
+                # ab_impls measures at ~0.2 s), the probe absorbs the
+                # real wait and probe_s exposes the lie — the stage
+                # time then uses t2.
+                leaf = jax.tree_util.tree_leaves(out)[0]
+                float(jnp.reshape(leaf, (-1,))[0])
+                t2 = time.perf_counter()
+                times[acc, st].append(t2 - t0)
+                probes[acc, st].append(t2 - t1)
 
     if args.trace:
         from fia_tpu.utils.timing import profile_trace
 
         for acc, eng in engines.items():
             a = (eng.params, eng.train_x, eng.train_y, eng._postings,
-                 txs[0])
+                 txs[0], eng._rowfeat)
             with profile_trace(os.path.join(args.trace, acc)):
                 jax.block_until_ready(fns[acc, "scores"](*a))
 
@@ -199,33 +244,43 @@ def main():
         "rounds": rounds,
         "total_related_rows_r0": total_rows,
         "peaks": peaks,
+        # fixed per-dispatch overhead (tunnel RPC + readiness + probe
+        # RTTs) measured by the null program; stage diffs cancel it,
+        # and the FIRST stage's device cost = its cum minus this
+        "null_overhead_s": round(min(null_times), 5),
+        "null_all_s": [round(t, 5) for t in null_times],
         "stages": {},
         "accum_ab": {},
     }
+    null = min(null_times)
     for acc in engines:
-        prev_t = 0.0
+        prev_t = null
         rows = {}
         for st in STAGES:
             # monotone clamp: stage prefixes are separately compiled
             # programs, so a later prefix's best can time under an
             # earlier one's; a negative stage delta is noise, not cost
             best = max(min(times[acc, st]), prev_t)
+            dev = max(best - null, 1e-6)  # overhead-corrected cum time
             fl, by = costs[acc, st]
             row = {
                 "cum_best_s": round(best, 5),
+                "cum_device_s": round(dev, 5),
                 "stage_s": round(best - prev_t, 5),
+                "all_s": [round(t, 5) for t in times[acc, st]],
+                "probe_s": [round(t, 5) for t in probes[acc, st]],
                 "xla_flops": fl,
                 "xla_bytes": by,
             }
-            if fl and best > 0:
-                row["achieved_gflops"] = round(fl / best / 1e9, 2)
+            if fl:
+                row["achieved_gflops"] = round(fl / dev / 1e9, 2)
                 row["pct_of_peak_flops"] = round(
-                    100 * fl / best / peaks["flops"], 3
+                    100 * fl / dev / peaks["flops"], 3
                 )
-            if by and best > 0:
-                row["achieved_gbps"] = round(by / best / 1e9, 2)
+            if by:
+                row["achieved_gbps"] = round(by / dev / 1e9, 2)
                 row["pct_of_hbm_bw"] = round(
-                    100 * by / best / peaks["hbm"], 1
+                    100 * by / dev / peaks["hbm"], 1
                 )
             prev_t = best
             rows[st] = row
@@ -235,10 +290,11 @@ def main():
             "full_best_s": full,
             "scores_per_sec": round(total_rows / full, 1),
         }
-    sc = result["accum_ab"]["scan"]["full_best_s"]
-    oh = result["accum_ab"]["onehot"]["full_best_s"]
-    result["accum_ab"]["onehot_speedup"] = round(sc / oh, 3)
-    result["accum_ab"]["winner"] = "onehot" if oh < sc else "scan"
+    names = [k for k in result["accum_ab"]]
+    ta = result["accum_ab"][names[0]]["full_best_s"]
+    tb = result["accum_ab"][names[1]]["full_best_s"]
+    result["accum_ab"][f"{names[1]}_speedup"] = round(ta / tb, 3)
+    result["accum_ab"]["winner"] = names[1] if tb < ta else names[0]
 
     # binding-roofline statement for the winner's dominant stage
     win = result["stages"][result["accum_ab"]["winner"]]

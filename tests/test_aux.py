@@ -171,6 +171,24 @@ class TestReferenceData:
         with np.testing.assert_raises(ValueError):
             fit_user_degree_profile(10, 500, 16, rng, max_degree=40)
 
+    def test_calibrated_splits_heldout_free(self):
+        """calibrated_splits (r4: cal2-style stream at scales with no
+        reference heldout, e.g. ML-20M): unique train pairs, disjoint
+        test pairs, valid star-scale ratings, full user coverage."""
+        from fia_tpu.data.synthetic import calibrated_splits
+
+        sp = calibrated_splits(500, 300, 40_000, 64, seed=3)
+        tr, te = sp["train"], sp["test"]
+        codes = tr.x[:, 0].astype(np.int64) * 300 + tr.x[:, 1]
+        assert len(np.unique(codes)) == len(codes)
+        assert len(tr.x) == 40_000
+        tcodes = te.x[:, 0].astype(np.int64) * 300 + te.x[:, 1]
+        assert not np.isin(tcodes, np.unique(codes)).any()
+        assert len(te.x) == 64
+        assert np.all((te.y >= 1) & (te.y <= 5))
+        udeg = np.bincount(tr.x[:, 0], minlength=500)
+        assert udeg.min() >= 1
+
     def test_calibrate_false_keeps_zipf_stream(self):
         """The round-1 Zipf stream stays reproducible for comparison."""
         from fia_tpu.data.loaders import load_dataset

@@ -376,6 +376,67 @@ class TestEngine:
                 flat.scores_of(t), padded.scores_of(t), rtol=1e-3, atol=1e-5
             )
 
+    def test_block_row_grads_hook_matches_autodiff(self, model_cls):
+        """The fast per-row block-Jacobian hook (closed-form for MF,
+        one batched backward for NCF) must reproduce the vmapped
+        single-row autodiff definition — for scalar query ids AND the
+        flat path's per-row (B,) id arrays, including rows that hit the
+        query pair on both sides."""
+        model, params, train = _setup(model_cls)
+        assert model.block_row_grads is not None
+        u, i = int(train.x[0, 0]), int(train.x[0, 1])
+        x = jnp.asarray(train.x[:64])
+        block0 = model.extract_block(params, u, i)
+        bvec0 = model.flatten_block(block0)
+
+        def one(xj, uu, ii):
+            b0 = model.extract_block(params, uu, ii)
+
+            def pred(bvec):
+                block = model.unflatten_block(bvec, b0)
+                return model.block_predict(
+                    params, block, uu, ii, xj[None, :]
+                )[0]
+
+            return jax.grad(pred)(model.flatten_block(b0))
+
+        ref_scalar = jax.vmap(lambda xj: one(xj, u, i))(x)
+        got_scalar = model.block_row_grads(params, u, i, x)
+        np.testing.assert_allclose(np.asarray(got_scalar),
+                                   np.asarray(ref_scalar),
+                                   rtol=1e-5, atol=1e-6)
+        # per-row ids (the flat engine's layout): each row queried
+        # against its own (u, i) — every row hits both sides
+        us, is_ = x[:, 0], x[:, 1]
+        ref_rows = jax.vmap(one)(x, us, is_)
+        got_rows = model.block_row_grads(params, us, is_, x)
+        np.testing.assert_allclose(np.asarray(got_rows),
+                                   np.asarray(ref_rows),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_row_feature_table_is_inert(self, model_cls):
+        """The fused row-feature table (one wide gather feeding the
+        flat program) is a pure performance knob — scores, ihvp and
+        counts must match the gather-per-tensor path exactly, including
+        a query pair present in train (the a·b cross-term rows)."""
+        model, params, train = _setup(model_cls)
+        pair = tuple(train.x[0])
+        pts = np.array([[3, 5], pair, [0, 1]], np.int32)
+        on = InfluenceEngine(model, params, train, damping=DAMP,
+                             impl="flat", row_features="on")
+        off = InfluenceEngine(model, params, train, damping=DAMP,
+                              impl="flat", row_features="off")
+        assert on._rowfeat is not None and off._rowfeat is None
+        r_on, r_off = on.query_batch(pts), off.query_batch(pts)
+        assert np.array_equal(r_on.counts, r_off.counts)
+        np.testing.assert_allclose(r_on.ihvp, r_off.ihvp, rtol=1e-5,
+                                   atol=1e-7)
+        for t in range(len(pts)):
+            np.testing.assert_allclose(
+                r_on.scores_of(t), r_off.scores_of(t), rtol=1e-5,
+                atol=1e-7
+            )
+
     def test_flat_accum_variants_agree(self, model_cls):
         """The one-hot-matmul segment reduction (the TPU MXU form) is a
         pure implementation knob — it must reproduce the scatter-add
@@ -413,7 +474,7 @@ class TestEngine:
             int(eng.index.counts_batch(pts).sum()), 2048
         )
         args = (eng.params, eng.train_x, eng.train_y, eng._postings,
-                jnp.asarray(pts, jnp.int32))
+                jnp.asarray(pts, jnp.int32), eng._rowfeat)
         ihvp_s, v_s = eng._flat_fn(s_pad, stage="solve")(*args)
         H = eng._flat_fn(s_pad, stage="hessian")(*args)
         g, e = eng._flat_fn(s_pad, stage="grads")(*args)
@@ -592,11 +653,11 @@ class TestAdaptiveChunking:
         real = eng._query_padded
         calls = []
 
-        def fake(test_points, pad_to):
+        def fake(test_points, pad_to, s_pad=None):
             calls.append(len(test_points))
             if len(test_points) > limit:
                 raise RuntimeError(msg)
-            return real(test_points, pad_to)
+            return real(test_points, pad_to, s_pad)
 
         eng._query_padded = fake
         return eng, calls
@@ -651,14 +712,14 @@ class TestAdaptiveChunking:
         real = eng._query_padded
         calls = []
 
-        def flaky(test_points, pad_to):
+        def flaky(test_points, pad_to, s_pad=None):
             calls.append(len(test_points))
             if len(calls) == 1:
                 raise RuntimeError(
                     "INTERNAL: HTTP 500: tpu_compile_helper subprocess "
                     "exit code 1"
                 )
-            return real(test_points, pad_to)
+            return real(test_points, pad_to, s_pad)
 
         eng._query_padded = flaky
         res = eng.query_batch(self.PTS)
@@ -715,7 +776,7 @@ class TestAdaptiveChunking:
                               impl="padded")
         real = eng._query_padded
 
-        def fake(test_points, pad_to):
+        def fake(test_points, pad_to, s_pad=None):
             n = len(test_points)
             if n == len(self.PTS):
                 raise RuntimeError("RESOURCE_EXHAUSTED: fake OOM")
@@ -724,7 +785,7 @@ class TestAdaptiveChunking:
                     "INTERNAL: HTTP 500: tpu_compile_helper subprocess "
                     "exit code 1"
                 )
-            return real(test_points, pad_to)
+            return real(test_points, pad_to, s_pad)
 
         eng._query_padded = fake
         res = eng.query_batch(self.PTS)
@@ -732,6 +793,96 @@ class TestAdaptiveChunking:
         assert eng._cells_bad < eng._cells_bad_hard < (1 << 62)
         ok, bad = memlimits.load(eng._memkey)
         assert bad == eng._cells_bad_hard  # hard ceiling persisted
+
+    WORKER_MSG = ("UNAVAILABLE: TPU worker process crashed or restarted. "
+                  "This can be caused by a kernel fault — check the "
+                  "kernel before re-running.")
+
+    def test_worker_crash_recovers_on_flat_path(self, model_cls):
+        """The r3 k=256 failure mode (BASELINE §4.1): the TPU worker
+        dies at runtime, taking every device buffer with it. The flat
+        path must rebuild device state and retry at half the batch —
+        bounded — and the stitched result must match a clean run."""
+        model, params, train = _setup(model_cls)
+        base = InfluenceEngine(model, params, train, damping=DAMP,
+                               impl="flat").query_batch(self.PTS)
+        eng = InfluenceEngine(model, params, train, damping=DAMP,
+                              impl="flat")
+        real = eng._dispatch_flat
+        calls = []
+
+        def flaky(pts, pad_to):
+            calls.append(len(pts))
+            if len(calls) == 1:
+                raise RuntimeError(self.WORKER_MSG)
+            return real(pts, pad_to)
+
+        eng._dispatch_flat = flaky
+        old_params = eng.params
+        res = eng.query_batch(self.PTS)
+        # full attempt failed, then two halves succeeded
+        assert calls[0] == len(self.PTS) and len(calls) == 3
+        assert eng.params is not old_params  # device state was rebuilt
+        assert np.array_equal(res.counts, base.counts)
+        for t in range(len(self.PTS)):
+            np.testing.assert_allclose(res.scores_of(t), base.scores_of(t),
+                                       rtol=1e-4, atol=1e-6)
+        assert eng._cells_bad == 1 << 62  # crash taught the envelope nothing
+
+    def test_worker_crash_recovers_in_query_many(self, model_cls):
+        """A crash mid-pipeline kills all in-flight dispatches; the
+        finalized prefix must survive and the remainder re-run."""
+        model, params, train = _setup(model_cls)
+        eng = InfluenceEngine(model, params, train, damping=DAMP,
+                              impl="flat")
+        base = [r for r in eng.query_many(self.PTS, batch_queries=2)]
+        fresh = InfluenceEngine(model, params, train, damping=DAMP,
+                                impl="flat")
+        real = fresh._finalize_flat
+        n = {"fails": 0}
+
+        def flaky(handle):
+            if n["fails"] == 0:
+                n["fails"] = 1
+                raise RuntimeError(self.WORKER_MSG)
+            return real(handle)
+
+        fresh._finalize_flat = flaky
+        got = fresh.query_many(self.PTS, batch_queries=2)
+        assert len(got) == len(base)
+        for g, b in zip(got, base):
+            assert np.array_equal(g.counts, b.counts)
+            for t in range(len(g.counts)):
+                np.testing.assert_allclose(g.scores_of(t), b.scores_of(t),
+                                           rtol=1e-4, atol=1e-6)
+
+    def test_worker_crash_on_padded_path_halves_without_envelope(
+        self, model_cls
+    ):
+        eng, calls = self._fake_oom_engine(model_cls, msg=self.WORKER_MSG)
+        res = eng.query_batch(self.PTS)
+        assert len(res.counts) == len(self.PTS)
+        # halved like a memory failure, but the envelope learned nothing
+        assert eng._cells_bad == 1 << 62
+        assert eng._cells_bad_hard == 1 << 62
+
+    def test_k256_block_clamps_flat_chunk(self, model_cls):
+        """d-aware accumulation-chunk clamp: at k=256 the MF block is
+        514-dim and the default 2048-chunk buffer is 2.2 GB — the size
+        that crashed the worker in r3. The clamp must cap it; small
+        reference blocks stay at the configured chunk."""
+        if model_cls is not MF:
+            return
+        model, params, train = _setup(MF)
+        small = InfluenceEngine(model, params, train, damping=DAMP)
+        assert small.flat_chunk == 2048  # d=34: untouched
+        big_model = MF(U, I, 256, 1e-3)
+        big_params = big_model.init_params(jax.random.PRNGKey(0))
+        big = InfluenceEngine(big_model, big_params, train, damping=DAMP)
+        assert big.flat_chunk * (514 ** 2) <= 64_000_000
+        # and the clamped engine still answers queries
+        r = big.query_batch(self.PTS[:2])
+        assert np.isfinite(r.ihvp).all()
 
     def test_concat_dense_branch(self, model_cls):
         from fia_tpu.influence.engine import InfluenceResult, _concat_results
@@ -768,11 +919,11 @@ class TestMemlimitsPersistence:
         real = eng._query_padded
         calls = []
 
-        def fake(test_points, pad_to):
+        def fake(test_points, pad_to, s_pad=None):
             calls.append(len(test_points))
             if len(test_points) > limit:
                 raise RuntimeError("RESOURCE_EXHAUSTED: fake OOM")
-            return real(test_points, pad_to)
+            return real(test_points, pad_to, s_pad)
 
         eng._query_padded = fake
         return eng, calls
@@ -845,9 +996,9 @@ class TestMemlimitsPersistence:
         real = eng._query_padded
         sizes = []
 
-        def spy(test_points, pad_to):
+        def spy(test_points, pad_to, s_pad=None):
             sizes.append(len(test_points))
-            return real(test_points, pad_to)
+            return real(test_points, pad_to, s_pad)
 
         eng._query_padded = spy
         eng.query_batch(self.PTS)
