@@ -139,6 +139,17 @@ def _classify_device_failure(e: Exception) -> str | None:
         "worker process crashed or restarted" in s
         or "kernel fault" in s
         or ("UNAVAILABLE" in s and "TPU worker" in s)
+        # the r4 k=256 crash's terse runtime form ("INTERNAL: TPU
+        # backend error (Internal)."); compile/lowering internals that
+        # happen to share the phrase must NOT trigger retry-at-half
+        # cascades — each halved shape is a fresh 40-66 s compile that
+        # would fail identically
+        or (
+            "TPU backend error" in s
+            and not any(
+                k in s for k in ("compile", "lower", "Mosaic")
+            )
+        )
     ):
         # The r3 k=256 failure mode: the TPU worker process died at
         # RUNTIME (not an XLA OOM — those fail at compile). Observed at
@@ -430,7 +441,7 @@ class InfluenceEngine:
         # 2 GB physical budget: (N, F) stores as (N, ceil(F/128)*128)
         return n * padded * 4 <= (2 << 30)
 
-    def _reset_device_state(self) -> None:
+    def _reset_device_state(self, max_wait_s: float = 120.0) -> None:
         """Recover from a TPU worker crash/restart ("kernel fault").
 
         Every device buffer this client held (params, train tensors,
@@ -439,9 +450,30 @@ class InfluenceEngine:
         drop them and re-upload. Host-side state (index, learned memory
         envelope, result caches) survives untouched, so recovery costs
         one re-upload plus recompiles of whatever runs next.
+
+        The worker takes seconds to come back after a crash — the r4
+        k=256 retry died AGAIN at ``device_put`` time because the
+        re-upload raced the restart — so upload failures that still
+        carry the worker-death signature back off exponentially up to
+        ``max_wait_s`` before surfacing.
         """
+        import time as _time
+
         self._jitted.clear()
-        self._upload_device_state()
+        deadline = _time.monotonic() + max_wait_s
+        delay = 2.0
+        while True:
+            try:
+                self._upload_device_state()
+                return
+            except Exception as e:
+                if (
+                    _classify_device_failure(e) != "worker"
+                    or _time.monotonic() + delay > deadline
+                ):
+                    raise
+                _time.sleep(delay)
+                delay = min(delay * 2.0, 30.0)
 
     # -- the pure per-test-point query ------------------------------------
     def _query_one(self, params, train_x, train_y, postings, u, i, test_x,

@@ -798,6 +798,25 @@ class TestAdaptiveChunking:
                   "This can be caused by a kernel fault — check the "
                   "kernel before re-running.")
 
+    def test_worker_class_signatures(self, model_cls):
+        """Every observed worker-death message classifies as 'worker'
+        (r3: UNAVAILABLE/kernel fault; r4 k=256 retry: INTERNAL 'TPU
+        backend error') — and ordinary errors stay unclassified."""
+        if model_cls is not MF:
+            return
+        from fia_tpu.influence.engine import _classify_device_failure
+
+        for msg in (self.WORKER_MSG,
+                    "INTERNAL: TPU backend error (Internal)."):
+            assert _classify_device_failure(RuntimeError(msg)) == "worker"
+        assert _classify_device_failure(RuntimeError("ValueError: x")) is None
+        # compile-phase internals sharing the phrase must NOT trigger
+        # retry-at-half cascades (each halved shape recompiles ~40-66 s
+        # and fails identically)
+        assert _classify_device_failure(RuntimeError(
+            "INTERNAL: TPU backend error: Mosaic lowering failed"
+        )) is None
+
     def test_worker_crash_recovers_on_flat_path(self, model_cls):
         """The r3 k=256 failure mode (BASELINE §4.1): the TPU worker
         dies at runtime, taking every device buffer with it. The flat
