@@ -87,6 +87,20 @@ def main() -> None:
     flat_res = flat_eng.query_batch(pts, pad_to=args.pad_to)
     assert np.array_equal(flat_res.counts, res.counts)
 
+    # replicated-table variant with the fused row-feature table: the
+    # table must replicate cross-process (put_global) and reproduce the
+    # sharded-table flat run
+    feat_eng = InfluenceEngine(model, params, train, damping=1e-3,
+                               mesh=mesh, impl="flat", row_features="on")
+    assert feat_eng._rowfeat is not None
+    feat_res = feat_eng.query_batch(pts, pad_to=args.pad_to)
+    assert np.array_equal(feat_res.counts, res.counts)
+    for t in range(len(pts)):
+        np.testing.assert_allclose(
+            feat_res.scores_of(t), flat_res.scores_of(t),
+            rtol=1e-4, atol=1e-6,
+        )
+
     # full-parameter engine over the same cross-process mesh: train rows
     # shard over 'data' (chunked HVP), params replicated, result
     # allgathered — every process gets the full (N,) score vector
