@@ -98,6 +98,12 @@ def base_parser(desc: str) -> argparse.ArgumentParser:
     p.add_argument("--synth_items", type=int, default=400)
     p.add_argument("--synth_train", type=int, default=50_000)
     p.add_argument("--synth_test", type=int, default=500)
+    p.add_argument("--query_batch", type=int, default=0,
+                   help="cap queries per device dispatch (0 = all at "
+                        "once); >0 routes through the pipelined "
+                        "query_many — e.g. 32 for the k=256 sweep "
+                        "point whose 64-query dispatch kills the TPU "
+                        "worker (BASELINE §4.1)")
     p.add_argument("--synth_stream", choices=["zipf", "cal"],
                    default="zipf",
                    help="synthetic train stream: 'zipf' (r1 generator) "

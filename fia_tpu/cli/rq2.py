@@ -49,7 +49,9 @@ def main(argv=None):
     test_idx = rng.choice(test.num_examples, size=n_queries, replace=False)
     points = test.x[test_idx]
 
-    timing = time_influence_queries(engine, points)
+    timing = time_influence_queries(
+        engine, points, batch_queries=args.query_batch or None
+    )
     # reference-format lines (matrix_factorization.py:225, 249-250)
     print(f"Inverse HVP + scoring for {timing.num_queries} queries took "
           f"{timing.total_time_s} sec")

@@ -49,30 +49,48 @@ def time_influence_queries(
     test_points: np.ndarray,
     repeats: int = 3,
     pad_to: int | None = None,
+    batch_queries: int | None = None,
 ) -> TimingResult:
     """Time batched influence queries over ``test_points`` (T, 2).
 
     The first call (compile + run) is measured separately; steady-state
     time is the best of ``repeats`` fenced runs, matching standard JAX
     benchmarking practice.
+
+    ``batch_queries``: cap the per-dispatch query count, routing through
+    the engine's pipelined ``query_many``. The k=256 MF program kills
+    the TPU worker at 64-query dispatches but runs at 32 (BASELINE
+    §4.1, r3-r4) — the sweep's 64-query protocol then times as two
+    windowed 32-query dispatches.
     """
     # pad_to=None lets the engine pick per its own pad_policy — its choice
     # is deterministic across repeats, so timing measures the same
     # compiled program production queries would use.
     test_points = np.asarray(test_points)
+    if batch_queries is not None and batch_queries < 1:
+        # a negative cap would make query_many's range() empty and
+        # silently bank a zero-score "benchmark"
+        raise ValueError(f"batch_queries must be >= 1, got {batch_queries}")
+
+    def run():
+        if batch_queries and batch_queries < len(test_points):
+            return engine.query_many(
+                test_points, batch_queries=batch_queries, pad_to=pad_to
+            )
+        return [engine.query_batch(test_points, pad_to=pad_to)]
 
     t0 = time.perf_counter()
-    res = engine.query_batch(test_points, pad_to=pad_to)
+    res = run()
     compile_time = time.perf_counter() - t0
 
     times = []
     for _ in range(repeats):
         t0 = time.perf_counter()
-        res = engine.query_batch(test_points, pad_to=pad_to)
+        res = run()
         times.append(time.perf_counter() - t0)
     best = min(times)
 
-    num_scores = int(res.counts.sum())
+    num_scores = int(sum(int(r.counts.sum()) for r in res))
     return TimingResult(
         num_queries=len(test_points),
         num_scores=num_scores,

@@ -1,0 +1,119 @@
+#!/bin/bash
+# Round-4 chip chain, tier 3: runs AFTER chip_chain_r4b.sh finishes
+# (waits on its "tier 2 done" line). The k=256 64-query retry with the
+# full r4 crash-recovery machinery (worker-class signatures incl. the
+# "TPU backend error" variant, restart backoff, bounded halving), a
+# longer padded-NCF descent, and a bench re-preview on a free host.
+set -u
+cd "$(dirname "$0")/.."
+STALL_S=${STALL_S:-1500}
+DEADLINE_EPOCH=$(date -d "2026-08-01 07:30:00 UTC" +%s)
+
+wait_tunnel() {
+  until timeout 60 python -c \
+    "import jax, jax.numpy as jnp; jnp.ones(()).block_until_ready()" \
+    >/dev/null 2>&1; do
+    sleep 60
+  done
+}
+
+past_deadline() { [ "$(date +%s)" -ge "$DEADLINE_EPOCH" ]; }
+
+banked() {
+  awk -v n="$1" '
+    /^chainR4d: / {
+      tail = " " n " ok"
+      tl = length(tail)
+      if (length($0) > tl + 8 &&
+          substr($0, length($0) - tl + 1) == tail &&
+          substr($0, length($0) - tl - 7, 8) ~ /^UTC [0-9][0-9][0-9][0-9]$/)
+        found = 1
+    }
+    END { exit !found }' output/chain.log
+}
+
+run_watched() {
+  local name="$1" log="$2"; shift 2
+  if banked "$name"; then
+    echo "chainR4d: $(date) $name already banked; skipping" >> output/chain.log
+    return 0
+  fi
+  if past_deadline; then
+    echo "chainR4d: $(date) $name skipped (07:30 deadline)" >> output/chain.log
+    return 1
+  fi
+  local attempt
+  for attempt in 1 2; do
+    echo "chainR4d: $(date) $name (attempt $attempt)" >> output/chain.log
+    "$@" > "$log" 2>&1 &
+    local pid=$!
+    local last_size=-1 stalled=0
+    while kill -0 "$pid" 2>/dev/null; do
+      sleep 60
+      local size
+      size=$(stat -c %s "$log" 2>/dev/null || echo 0)
+      if [ "$size" -eq "$last_size" ]; then
+        stalled=$((stalled + 60))
+      else
+        stalled=0
+        last_size=$size
+      fi
+      if [ "$stalled" -ge "$STALL_S" ]; then
+        echo "chainR4d: $(date) $name STALLED (${STALL_S}s); killing" >> output/chain.log
+        kill "$pid" 2>/dev/null
+        sleep 5
+        kill -9 "$pid" 2>/dev/null
+        break
+      fi
+    done
+    wait "$pid" 2>/dev/null
+    local rc=$?
+    if [ "$stalled" -lt "$STALL_S" ] && [ "$rc" -eq 0 ]; then
+      echo "chainR4d: $(date) $name ok" >> output/chain.log
+      return 0
+    fi
+    echo "chainR4d: $(date) $name failed (rc=$rc); re-probing tunnel" >> output/chain.log
+    past_deadline && return 1
+    wait_tunnel
+  done
+  echo "chainR4d: $(date) $name GAVE UP after 2 attempts" >> output/chain.log
+  return 1
+}
+
+# wait for tier 2 to release the chip
+until grep -q "^chainR4c: .* tier 3 done" output/chain.log; do
+  past_deadline && exit 0
+  sleep 120
+done
+
+echo "chainR4d: $(date) tier 4 starting" >> output/chain.log
+wait_tunnel
+
+run_watched "RQ2 embed k256 64q as 2x32" output/RQ2_MF_movielens_k256_64q_b32.log \
+  python -m fia_tpu.cli.rq2 --embed_size 256 --dataset movielens --model MF \
+  --data_dir /root/reference/data --train_dir output --num_test 64 \
+  --query_batch 32
+
+run_watched "MF Yelp wide-sample n8 (2k x 2)" output/rq1_mf_yelp_cal2_n8.log \
+  python -m fia_tpu.cli.rq1 --dataset yelp --data_dir /root/reference/data \
+  --model MF --num_test 8 --num_steps_train 15000 \
+  --num_steps_retrain 2000 --retrain_times 2 --num_to_remove 30 \
+  --batch_size 3009 --lane_chunk 16
+
+run_watched "RQ2 re-measure movielens MF" output/rq2_mf_ml_r4.log \
+  python -m fia_tpu.cli.rq2 --dataset movielens --data_dir /root/reference/data \
+  --train_dir output --model MF --num_test 256
+
+run_watched "RQ2 re-measure movielens NCF" output/rq2_ncf_ml_r4.log \
+  python -m fia_tpu.cli.rq2 --dataset movielens --data_dir /root/reference/data \
+  --train_dir output --model NCF --num_test 256
+
+run_watched "RQ2 re-measure yelp MF" output/rq2_mf_yelp_r4.log \
+  python -m fia_tpu.cli.rq2 --dataset yelp --data_dir /root/reference/data \
+  --train_dir output --model MF --num_test 256
+
+run_watched "RQ2 re-measure yelp NCF" output/rq2_ncf_yelp_r4.log \
+  python -m fia_tpu.cli.rq2 --dataset yelp --data_dir /root/reference/data \
+  --train_dir output --model NCF --num_test 256
+
+echo "chainR4d: $(date) tier 4 done" >> output/chain.log
