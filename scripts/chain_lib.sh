@@ -1,0 +1,95 @@
+# Shared harness for the chip measurement chains (sourced, not run).
+#
+# A chain script sets CHAIN_TAG (the chain.log line prefix) and
+# DEADLINE_EPOCH, sources this file, then declares jobs with
+# run_watched. Extracted in r4 after the fourth verbatim copy of this
+# logic drifted (a stale header described another chain's jobs).
+#
+#   CHAIN_TAG=chainR9 DEADLINE_EPOCH=$(date -d ... +%s)
+#   source "$(dirname "$0")/chain_lib.sh"
+#   run_watched "<job name>" <logfile> <cmd...>
+#
+# Behavior: single-occupancy chip etiquette (wait_tunnel probes before
+# work), per-job stall watchdog (STALL_S seconds without log growth
+# kills the job), one retry after a tunnel re-probe, idempotent
+# re-runs ("<name> ok" lines in output/chain.log mark banked jobs),
+# and a hard deadline after which jobs are skipped so the driver's
+# end-of-round bench gets a free chip.
+
+STALL_S=${STALL_S:-1500}
+: "${CHAIN_TAG:?set CHAIN_TAG before sourcing chain_lib.sh}"
+: "${DEADLINE_EPOCH:?set DEADLINE_EPOCH before sourcing chain_lib.sh}"
+
+wait_tunnel() {
+  until timeout 60 python -c \
+    "import jax, jax.numpy as jnp; jnp.ones(()).block_until_ready()" \
+    >/dev/null 2>&1; do
+    sleep 60
+  done
+}
+
+past_deadline() { [ "$(date +%s)" -ge "$DEADLINE_EPOCH" ]; }
+
+banked() {
+  # Exact "<tag>: <date> UTC <year> <name> ok" suffix match, no regex
+  # escaping of the job name needed; anchoring on "UTC <year> " stops
+  # a job name that suffixes another's from masking it.
+  awk -v n="$1" -v tag="^${CHAIN_TAG}: " '
+    $0 ~ tag {
+      tail = " " n " ok"
+      tl = length(tail)
+      if (length($0) > tl + 8 &&
+          substr($0, length($0) - tl + 1) == tail &&
+          substr($0, length($0) - tl - 7, 8) ~ /^UTC [0-9][0-9][0-9][0-9]$/)
+        found = 1
+    }
+    END { exit !found }' output/chain.log
+}
+
+run_watched() {  # run_watched <name> <logfile> <cmd...>
+  local name="$1" log="$2"; shift 2
+  if banked "$name"; then
+    echo "${CHAIN_TAG}: $(date) $name already banked; skipping" >> output/chain.log
+    return 0
+  fi
+  if past_deadline; then
+    echo "${CHAIN_TAG}: $(date) $name skipped (deadline)" >> output/chain.log
+    return 1
+  fi
+  local attempt
+  for attempt in 1 2; do
+    echo "${CHAIN_TAG}: $(date) $name (attempt $attempt)" >> output/chain.log
+    "$@" > "$log" 2>&1 &
+    local pid=$!
+    local last_size=-1 stalled=0
+    while kill -0 "$pid" 2>/dev/null; do
+      sleep 60
+      local size
+      size=$(stat -c %s "$log" 2>/dev/null || echo 0)
+      if [ "$size" -eq "$last_size" ]; then
+        stalled=$((stalled + 60))
+      else
+        stalled=0
+        last_size=$size
+      fi
+      if [ "$stalled" -ge "$STALL_S" ]; then
+        echo "${CHAIN_TAG}: $(date) $name STALLED (${STALL_S}s); killing" >> output/chain.log
+        kill "$pid" 2>/dev/null
+        sleep 5
+        kill -9 "$pid" 2>/dev/null
+        break
+      fi
+    done
+    wait "$pid" 2>/dev/null
+    local rc=$?
+    if [ "$stalled" -lt "$STALL_S" ] && [ "$rc" -eq 0 ]; then
+      echo "${CHAIN_TAG}: $(date) $name ok" >> output/chain.log
+      return 0
+    fi
+    echo "${CHAIN_TAG}: $(date) $name failed (rc=$rc); re-probing tunnel" >> output/chain.log
+    past_deadline && return 1
+    wait_tunnel
+  done
+  echo "${CHAIN_TAG}: $(date) $name GAVE UP after 2 attempts" >> output/chain.log
+  return 1
+}

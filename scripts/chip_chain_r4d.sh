@@ -1,9 +1,11 @@
 #!/bin/bash
-# Round-4 chip chain, tier 3: runs AFTER chip_chain_r4b.sh finishes
-# (waits on its "tier 2 done" line). The k=256 64-query retry with the
-# full r4 crash-recovery machinery (worker-class signatures incl. the
-# "TPU backend error" variant, restart backoff, bounded halving), a
-# longer padded-NCF descent, and a bench re-preview on a free host.
+# Round-4 chip chain, tier 4: runs AFTER chip_chain_r4c.sh finishes
+# (waits on its "tier 3 done" line). The k=256 64-query sweep point as
+# two windowed 32-query dispatches (--query_batch 32, the measured-safe
+# size), the MF Yelp wide-sample attestation that completes the n=8
+# matrix, and post-optimization RQ2 re-measures on both real datasets.
+# (Historical note: ran with its own inlined harness copy; later
+# chains source scripts/chain_lib.sh instead.)
 set -u
 cd "$(dirname "$0")/.."
 STALL_S=${STALL_S:-1500}
