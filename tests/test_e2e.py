@@ -85,6 +85,22 @@ class TestEndToEnd:
             sum(engine.index.related_count(int(u), int(i)) for u, i in pts)
         )
 
+    def test_timing_harness_capped_dispatch(self, tiny_splits, trained):
+        """--query_batch routing (the k=256 crash mitigation): capped
+        dispatch must count every score exactly once and reject
+        nonsensical caps instead of banking a zero-score benchmark."""
+        model, state, _ = trained
+        engine = InfluenceEngine(model, state.params, tiny_splits["train"],
+                                 damping=1e-4)
+        pts = tiny_splits["test"].x[:8]
+        whole = time_influence_queries(engine, pts, repeats=1)
+        capped = time_influence_queries(engine, pts, repeats=1,
+                                        batch_queries=3)
+        assert capped.num_queries == whole.num_queries == 8
+        assert capped.num_scores == whole.num_scores
+        with pytest.raises(ValueError, match="batch_queries"):
+            time_influence_queries(engine, pts, batch_queries=-1)
+
 
 class TestCLI:
     def test_rq2_cli_runs(self, tmp_path, monkeypatch):
