@@ -1,0 +1,27 @@
+#!/bin/bash
+# Round-4 chip chain, tier 6: runs after tier 5 (waits on its done
+# line). The Yelp NCF full-protocol fidelity at num_test=4 — with
+# tier 5's ML-1M n=4 this upgrades BOTH NCF full-protocol headlines
+# from 2 to 4 sampled test points at the reference's own 18k x 4
+# budget (~35 min/point measured from tier 5's chunk rate).
+set -u
+cd "$(dirname "$0")/.."
+CHAIN_TAG=chainR4f
+DEADLINE_EPOCH=$(date -d "2026-08-01 06:45:00 UTC" +%s)
+source "$(dirname "$0")/chain_lib.sh"
+
+until grep -q "^chainR4e: .* tier 5 done" output/chain.log; do
+  past_deadline && exit 0
+  sleep 120
+done
+
+echo "chainR4f: $(date) tier 6 starting" >> output/chain.log
+wait_tunnel
+
+run_watched "NCF Yelp full-protocol n4 (18k x 4)" output/rq1_ncf_yelp_full_n4.log \
+  python -m fia_tpu.cli.rq1 --dataset yelp --data_dir /root/reference/data \
+  --model NCF --num_test 4 --num_steps_train 12000 \
+  --num_steps_retrain 18000 --retrain_times 4 --num_to_remove 50 \
+  --batch_size 3009 --lane_chunk 16 --steps_per_dispatch 1000
+
+echo "chainR4f: $(date) tier 6 done" >> output/chain.log
