@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""Diagnose the per-point spread of the RQ1 fidelity rows.
+
+The r4 NCF ML-1M full-protocol points spread r = 0.71-0.94 while MF
+sits at 0.99+ everywhere; this script asks WHY, from the banked npz
+artifacts alone (no chip time). Parity anchor: the artifacts follow the
+reference's RQ1 layout (actual/predicted loss diffs per removal,
+``/root/reference/src/scripts/RQ1.py:142-165``); the reference never
+looks past the pooled correlation.
+
+Per test point it reports:
+  - r                : Pearson(actual, predicted) over that point's removals
+  - std_actual       : the point's signal scale (std of actual loss diffs)
+  - slope            : OLS slope actual ~ predicted (calibration; 1.0 = unbiased)
+  - resid_std        : std of the OLS residual (the point's absolute error)
+
+and tests a one-parameter explanation of the spread: a NOISE-FLOOR
+model r_hat_i = sqrt(max(0, 1 - (floor / std_actual_i)^2)) where
+``floor`` is the POOLED resid_std across the file's points (one number
+per artifact — per-point r is then a deterministic function of the
+point's signal scale). If the model fits (small |r_hat - r|), the
+spread is signal-to-noise geometry, not variable prediction quality:
+every point is predicted with the same absolute accuracy, and low-r
+points are simply points whose loss-diff signal is small against the
+file's fixed error floor.
+
+Usage: python scripts/fidelity_spread.py [--npz output/RQ1-*.npz ...]
+Writes output/fidelity_spread.json and prints one block per artifact.
+"""
+
+import argparse
+import glob
+import json
+import os
+
+import numpy as np
+
+
+def point_diagnostics(actual, predicted, groups):
+    """Per-point spread diagnostics + pooled-floor model check.
+
+    Returns a dict: per_point rows, pooled floor (rms of per-point
+    residual stds, each point weighted equally), and the model fit
+    |r_hat - r| per point. All moments are computed in float64; the
+    artifacts store float32.
+    """
+    actual = np.asarray(actual, np.float64)
+    predicted = np.asarray(predicted, np.float64)
+    groups = np.asarray(groups)
+    per_point = {}
+    resid_vars = []
+    for g in np.unique(groups):
+        m = groups == g
+        aa, pp = actual[m], predicted[m]
+        if m.sum() < 3 or aa.std() == 0 or pp.std() == 0:
+            continue
+        r = float(np.corrcoef(aa, pp)[0, 1])
+        coeffs = np.polyfit(pp, aa, 1)
+        resid = aa - np.polyval(coeffs, pp)
+        per_point[int(g)] = {
+            "n": int(m.sum()),
+            "r": round(r, 4),
+            "std_actual": float(aa.std()),
+            "slope": round(float(coeffs[0]), 4),
+            "resid_std": float(resid.std()),
+        }
+        resid_vars.append(float(resid.var()))
+    if not per_point:
+        return {"per_point": {}, "floor": float("nan")}
+    floor = float(np.sqrt(np.mean(resid_vars)))
+    floors = np.sqrt(resid_vars)
+    for row in per_point.values():
+        ratio = min(1.0, floor / row["std_actual"])
+        # snr < ~1.5 is the hypersensitive regime: d r_hat / d floor
+        # blows up as signal approaches the floor, so the pooled-floor
+        # model cannot pin r there (its failing is the diagnosis — the
+        # point is noise-dominated).
+        row["snr"] = round(row["std_actual"] / floor, 2)
+        row["r_model"] = round(float(np.sqrt(1.0 - ratio**2)), 4)
+        row["model_abs_err"] = round(abs(row["r_model"] - row["r"]), 4)
+    return {
+        "per_point": per_point,
+        "floor": floor,
+        "floor_cv": float(floors.std() / floors.mean()),
+        "signal_cv": float(np.std([p["std_actual"] for p in per_point.values()])
+                           / np.mean([p["std_actual"] for p in per_point.values()])),
+        "model_max_abs_err": max(p["model_abs_err"] for p in per_point.values()),
+        "slope_range": [min(p["slope"] for p in per_point.values()),
+                        max(p["slope"] for p in per_point.values())],
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--npz", nargs="*", default=None)
+    ap.add_argument("--out", default=os.path.join("output",
+                                                  "fidelity_spread.json"))
+    args = ap.parse_args()
+    paths = args.npz or sorted(glob.glob(os.path.join("output", "RQ1-*.npz")))
+    report = {}
+    for path in paths:
+        d = np.load(path)
+        rep = point_diagnostics(d["actual_loss_diffs"],
+                                d["predicted_loss_diffs"],
+                                d["test_index_of_row"])
+        report[os.path.basename(path)] = rep
+        print(f"== {os.path.basename(path)}: floor={rep['floor']:.3e} "
+              f"(cv {rep.get('floor_cv', float('nan')):.2f}) "
+              f"signal cv {rep.get('signal_cv', float('nan')):.2f} "
+              f"model max|dr|={rep.get('model_max_abs_err', float('nan'))}")
+        for g, row in rep["per_point"].items():
+            print(f"   t={g:5d} r={row['r']:+.4f} model={row['r_model']:+.4f} "
+                  f"std_a={row['std_actual']:.3e} slope={row['slope']:+.3f}")
+    if os.path.dirname(args.out):
+        os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as fh:
+        json.dump(report, fh, indent=1)
+    print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,56 @@
+"""Post-processing analysis tools over RQ1 artifacts (scripts/)."""
+
+import importlib.util
+import os
+
+import numpy as np
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        name,
+        os.path.join(os.path.dirname(__file__), "..", "scripts",
+                     f"{name}.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestFidelitySpread:
+    """scripts/fidelity_spread.py: the pooled-floor model must recover a
+    constructed fixed noise floor and explain per-point r on synthetic
+    artifacts shaped like the RQ1 npz output."""
+
+    def test_floor_recovery_and_model_fit(self):
+        mod = _load_script("fidelity_spread")
+        rng = np.random.default_rng(3)
+        floor = 2e-3
+        groups, actual, predicted = [], [], []
+        # signal scales straddling the floor: high-SNR points must fit
+        # the model tightly, and the recovered floor must match
+        for g, sig in enumerate([4e-3, 8e-3, 16e-3, 32e-3]):
+            pred = rng.normal(0.0, sig, 200)
+            act = pred + rng.normal(0.0, floor, 200)
+            groups += [g] * 200
+            actual.append(act)
+            predicted.append(pred)
+        rep = mod.point_diagnostics(
+            np.concatenate(actual), np.concatenate(predicted),
+            np.array(groups),
+        )
+        assert abs(rep["floor"] - floor) / floor < 0.15
+        for row in rep["per_point"].values():
+            assert abs(row["slope"] - 1.0) < 0.1
+            if row["snr"] > 1.5:
+                assert row["model_abs_err"] < 0.05
+
+    def test_degenerate_groups_skipped(self):
+        mod = _load_script("fidelity_spread")
+        # constant actuals / too-small groups must be skipped, not crash
+        rep = mod.point_diagnostics(
+            np.array([1.0, 1.0, 1.0, 0.5, 0.6]),
+            np.array([0.1, 0.2, 0.3, 0.4, 0.5]),
+            np.array([0, 0, 0, 1, 1]),
+        )
+        assert rep["per_point"] == {}
