@@ -40,6 +40,10 @@ def base_parser(desc: str) -> argparse.ArgumentParser:
                    choices=["movielens", "yelp", "synthetic"])
     p.add_argument("--model", type=str, default="MF", choices=["MF", "NCF"])
     p.add_argument("--num_test", type=int, default=5)
+    p.add_argument("--test_indices", type=int, nargs="+", default=None,
+                   help="explicit test-split row indices; overrides the "
+                        "num_test sampler (resume a truncated run's "
+                        "missing points, or probe a specific query)")
     p.add_argument("--num_steps_train", type=int, default=80_000)
     p.add_argument("--num_steps_retrain", type=int, default=24_000)
     p.add_argument("--reset_adam", type=int, default=0)
@@ -182,6 +186,23 @@ def apply_backend(args) -> None:
     jax.config.update("jax_platforms", args.backend)
 
 
+def explicit_test_indices(args, test):
+    """Validated ``--test_indices`` as an int64 array, or None when the
+    flag is unset. The single source of truth for every driver (rq1 via
+    pick_test_points, rq2 directly); load_splits also calls it so a
+    typo'd index fails BEFORE the training phase, which can cost hours
+    on a resumed full protocol."""
+    vals = getattr(args, "test_indices", None)
+    if not vals:
+        return None
+    idx = np.asarray(vals, dtype=np.int64)
+    if idx.min() < 0 or idx.max() >= test.num_examples:
+        raise SystemExit(
+            f"--test_indices out of range [0, {test.num_examples})"
+        )
+    return idx
+
+
 def load_splits(args):
     if args.dataset == "synthetic":
         if getattr(args, "synth_stream", "zipf") == "cal":
@@ -194,17 +215,19 @@ def load_splits(args):
             # tag checkpoints so a cal-stream run never loads a
             # Zipf-stream checkpoint (and vice versa)
             args._synth_tag = "calsynth"
-            return splits
-        return synthetic_splits(
-            args.synth_users, args.synth_items, args.synth_train,
-            args.synth_test, seed=args.seed,
-        )
-    splits = load_dataset(args.dataset, args.data_dir, synthesize_train=True,
-                          synth_seed=args.seed,
-                          calibrate=bool(getattr(args, "calibrate", 1)))
-    # generator tag flows into checkpoint/model names (model_name_for):
-    # a calibrated-split run must never load a Zipf-split checkpoint
-    args._synth_tag = getattr(splits["train"], "synth_tag", "")
+        else:
+            splits = synthetic_splits(
+                args.synth_users, args.synth_items, args.synth_train,
+                args.synth_test, seed=args.seed,
+            )
+    else:
+        splits = load_dataset(args.dataset, args.data_dir,
+                              synthesize_train=True, synth_seed=args.seed,
+                              calibrate=bool(getattr(args, "calibrate", 1)))
+        # generator tag flows into checkpoint/model names (model_name_for):
+        # a calibrated-split run must never load a Zipf-split checkpoint
+        args._synth_tag = getattr(splits["train"], "synth_tag", "")
+    explicit_test_indices(args, splits["test"])  # fail fast, all paths
     return splits
 
 
@@ -285,6 +308,9 @@ def pick_test_points(args, splits, engine_index):
     """Random test points, or the least-supported ones when
     sort_test_case=1 (reference RQ1.py:130-137)."""
     test = splits["test"]
+    idx = explicit_test_indices(args, test)
+    if idx is not None:
+        return idx
     rng = np.random.default_rng(args.seed)
     if args.sort_test_case:
         counts = np.array(

@@ -44,9 +44,12 @@ def main(argv=None):
         **common.engine_kwargs(args),
     )
 
-    rng = np.random.default_rng(args.seed + 17)
-    n_queries = max(args.num_test, 1)
-    test_idx = rng.choice(test.num_examples, size=n_queries, replace=False)
+    test_idx = common.explicit_test_indices(args, test)
+    if test_idx is None:
+        rng = np.random.default_rng(args.seed + 17)
+        n_queries = max(args.num_test, 1)
+        test_idx = rng.choice(test.num_examples, size=n_queries,
+                              replace=False)
     points = test.x[test_idx]
 
     timing = time_influence_queries(

@@ -1,0 +1,58 @@
+#!/bin/bash
+# Frees the machine before the driver's end-of-round bench (round 4,
+# continuation session). The TPU is single-occupancy through the
+# tunnel; a fidelity run still holding it at round end would force
+# BENCH_r04 onto the CPU fallback (round 2's biggest miss).
+#
+# Deadline rationale: the original r4 guard assumed round start
+# (~21:09 Jul 31) + 12h => fired 07:45 UTC Aug 1, but the round did
+# NOT end then — the driver restarted the builder at 07:44 with a
+# fresh 1000-turn budget (PROGRESS.jsonl shows the round already 22h
+# old at that point, so the 12h figure is per-session, not absolute).
+# This guard backstops the CONTINUATION session: 07:44 + ~12h => ends
+# ~19:45; fire at 18:45 for margin. If the round ends earlier the
+# builder frees the chip itself before stopping.
+#
+# Kill matching: the old guards used `pgrep -f "python.*(...|bench\.py)"`,
+# which MATCHES THE DRIVER'S OWN COMMAND LINE — the claude invocation
+# quotes the whole build prompt, which contains both "python -m pytest"
+# and "bench.py" — and that is the likely killer of the 07:44 builder
+# session (guard fired 07:45:00, "killed 6 chain processes"). Match on
+# a "python" ARGV0 PREFIX instead: measurement jobs start with
+# "python ..."; the driver starts with "claude", the relay with
+# "python3 -u /root/.relay.py", and neither can match below.
+set -u
+cd "$(dirname "$0")/.."
+
+exec 9> output/.endguard_r4g.lock
+flock -n 9 || exit 0
+
+log() { echo "endguardR4g: $(date) $*" >> output/chain.log; }
+
+DEADLINE_EPOCH=$(date -d "2026-08-01 18:45:00 UTC" +%s)
+now=$(date +%s)
+if [ "$DEADLINE_EPOCH" -gt "$now" ]; then
+  sleep $(( DEADLINE_EPOCH - now ))
+fi
+
+killed=0
+while read -r pid args; do
+  [ "$pid" = "$$" ] && continue
+  case "$args" in
+    python*fia_tpu.cli.rq1*|python*fia_tpu.cli.rq2*|\
+    python*ab_impls*|python*roofline*|python*scripts/stress*|\
+    python*bench.py*)
+      # argv[0] must BE python (prefix case above allows python3 etc.);
+      # reject anything whose argv0 merely CONTAINS the patterns deep
+      # in a quoted prompt (the driver's argv0 is "claude" and never
+      # reaches this branch)
+      kill "$pid" 2>/dev/null && killed=$((killed + 1))
+      ;;
+  esac
+done < <(ps -eo pid= -o args=)
+
+if [ "$killed" -gt 0 ]; then
+  log "deadline reached; freed the chip (killed $killed measurement jobs)"
+else
+  log "deadline reached; chip already free"
+fi

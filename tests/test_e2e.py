@@ -116,6 +116,19 @@ class TestCLI:
         ])
         assert timing.num_queries == 4
 
+    def test_rq2_cli_explicit_test_indices(self, tmp_path):
+        from fia_tpu.cli import rq2
+
+        timing = rq2.main([
+            "--dataset", "synthetic", "--model", "MF",
+            "--synth_users", "40", "--synth_items", "30",
+            "--synth_train", "1500", "--synth_test", "50",
+            "--num_steps_train", "100", "--test_indices", "5", "9", "11",
+            "--embed_size", "4", "--batch_size", "150",
+            "--train_dir", str(tmp_path),
+        ])
+        assert timing.num_queries == 3
+
     def test_stress_driver_smoke(self):
         """scripts/stress.py (ML-20M stress config, BASELINE.json config 5)
         runs end-to-end with table sharding on the virtual mesh."""
@@ -151,6 +164,46 @@ class TestCLI:
             "--lr", "1e-2", "--train_dir", str(tmp_path),
         ])
         assert np.isfinite(r)
+
+    def test_rq1_cli_explicit_test_indices(self, tmp_path):
+        """--test_indices pins the exact points (resume path for a
+        truncated multi-point run); the artifact must carry them."""
+        from fia_tpu.cli import rq1
+
+        r = rq1.main([
+            "--dataset", "synthetic", "--model", "MF",
+            "--synth_users", "40", "--synth_items", "30",
+            "--synth_train", "1500", "--synth_test", "50",
+            "--num_steps_train", "400", "--num_steps_retrain", "200",
+            "--test_indices", "7", "3", "--retrain_times", "1",
+            "--embed_size", "4", "--batch_size", "150",
+            "--lr", "1e-2", "--train_dir", str(tmp_path),
+            "--num_to_remove", "6",
+        ])
+        assert np.isfinite(r)
+        art = np.load(tmp_path / "RQ1-MF-synthetic.npz")
+        assert set(art["test_index_of_row"]) == {7, 3}
+
+    def test_rq1_cli_test_indices_out_of_range(self, tmp_path):
+        """A typo'd index must fail in load_splits — BEFORE the training
+        phase (hours on a resumed full protocol), not after it."""
+        import pytest
+
+        from fia_tpu.cli import common
+
+        args = common.base_parser("t").parse_args([
+            "--dataset", "synthetic", "--model", "MF",
+            "--synth_users", "40", "--synth_items", "30",
+            "--synth_train", "1500", "--synth_test", "50",
+            "--test_indices", "50",
+            "--train_dir", str(tmp_path),
+        ])
+        with pytest.raises(SystemExit, match="out of range"):
+            common.load_splits(args)
+        # negative indices are rejected too (numpy would silently wrap)
+        args.test_indices = [-1]
+        with pytest.raises(SystemExit, match="out of range"):
+            common.load_splits(args)
 
     def test_rq1_cli_mesh_and_event_log(self, tmp_path):
         """--mesh 8 runs the whole RQ1 pipeline (training, queries, LOO
