@@ -52,7 +52,22 @@ def main(argv=None):
     test_indices = common.pick_test_points(args, splits, engine.index)
     print(f"test indices: {list(map(int, test_indices))}")
 
+    # Resume runs (--test_indices) must not clobber a truncated run's
+    # banked artifact in the same train_dir: divert to a suffixed path
+    # when the canonical artifact already exists (merge is a cheap
+    # post-processing step; re-banking hours of chip time is not)
+    art_path = os.path.join(
+        args.train_dir, f"RQ1-{args.model}-{args.dataset}.npz"
+    )
+    if args.test_indices and os.path.exists(art_path):
+        suffix = "-".join(str(int(t)) for t in test_indices)
+        art_path = os.path.join(
+            args.train_dir, f"RQ1-{args.model}-{args.dataset}-pt{suffix}.npz"
+        )
+        print(f"existing artifact kept; resume rows -> {art_path}")
+
     actuals, predictions, removed = [], [], []
+    repeat_rows, drift_rows, y0s = [], [], []
     for t in test_indices:
         res = test_retraining(
             engine, train, test, int(t),
@@ -74,12 +89,19 @@ def main(argv=None):
         actuals.append(res.actual_y_diffs)
         predictions.append(res.predicted_y_diffs)
         removed.append(res.indices_to_remove)
+        repeat_rows.append(res.per_repeat_y[:-1])
+        drift_rows.append(res.per_repeat_y[-1])
+        y0s.append(res.y0)
 
         # per-test-point rows can be ragged (a test point's related set
         # may hold fewer than num_to_remove rows), so stack as flat
-        # arrays plus per-row test-point ids rather than a (T, R) matrix
+        # arrays plus per-row test-point ids rather than a (T, R) matrix.
+        # repeat_y rows align with actual_loss_diffs rows; the per-point
+        # drift lane and original prediction ride alongside so the
+        # noise-floor decomposition (scripts/fidelity_spread.py) can run
+        # from the artifact alone
         save_npz_atomic(
-            os.path.join(args.train_dir, f"RQ1-{args.model}-{args.dataset}.npz"),
+            art_path,
             actual_loss_diffs=np.concatenate(actuals),
             predicted_loss_diffs=np.concatenate(predictions),
             indices_to_remove=np.concatenate(removed),
@@ -87,6 +109,9 @@ def main(argv=None):
                 [int(i) for i in test_indices[: len(actuals)]],
                 [len(a) for a in actuals],
             ),
+            repeat_y=np.concatenate(repeat_rows),
+            drift_repeat_y=np.stack(drift_rows),
+            y0_of_point=np.asarray(y0s, np.float32),
         )
 
     a = np.concatenate(actuals)

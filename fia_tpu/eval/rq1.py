@@ -32,6 +32,13 @@ class RetrainResult:
     indices_to_remove: np.ndarray  # (R,) positions into the related set
     removed_train_rows: np.ndarray  # (R,) train-row ids
     bias_retrain: float  # no-removal drift (subtracted from actuals)
+    # raw per-repeat retrained predictions, (R+1, retrain_times): row r
+    # holds lane r's repeats, the final row the no-removal drift lane.
+    # Across-repeat variance measures RETRAINING noise directly — the
+    # floor decomposition in scripts/fidelity_spread.py separates it
+    # from influence-prediction error at zero extra device cost
+    per_repeat_y: np.ndarray = None
+    y0: float = 0.0  # original (pre-removal) prediction on the test point
 
 
 def test_retraining(
@@ -158,4 +165,6 @@ def test_retraining(
         indices_to_remove=np.asarray(sel),
         removed_train_rows=np.asarray(removed_rows),
         bias_retrain=bias,
+        per_repeat_y=np.asarray(preds, np.float32),
+        y0=y0,
     )

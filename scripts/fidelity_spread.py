@@ -90,6 +90,57 @@ def point_diagnostics(actual, predicted, groups):
     }
 
 
+def noise_decomposition(actual, predicted, groups, repeat_y, floors=None):
+    """Split each point's error floor into RETRAINING NOISE vs
+    PREDICTION ERROR, using the raw per-repeat retrained predictions
+    (artifact field ``repeat_y``, (rows, retrain_times), r4+).
+
+    Each row's banked actual is the mean of K retrain repeats minus the
+    point's drift bias; the OLS fit behind ``resid_std`` absorbs the
+    bias term (it is constant within a point), so the noise on a row's
+    actual is Var(repeats)/K. Averaging the per-lane variances across a
+    point's ~50 rows gives a tight noise estimate, and
+    prediction_error = sqrt(floor^2 - noise^2). NaN repeats are dropped
+    per-lane, mirroring the harness's nanmean (reference drops NaN
+    retrain outcomes, ``experiments.py:136-137``). Points whose lanes
+    all have <2 finite repeats (e.g. retrain_times=1 artifacts) are
+    undecomposable and skipped. ``floors`` optionally supplies each
+    point's resid_std from ``point_diagnostics`` (main passes it so the
+    two reports cannot disagree); when None it is recomputed here.
+    """
+    actual = np.asarray(actual, np.float64)
+    predicted = np.asarray(predicted, np.float64)
+    repeat_y = np.asarray(repeat_y, np.float64)
+    groups = np.asarray(groups)
+    out = {}
+    for g in np.unique(groups):
+        m = groups == g
+        aa, pp, reps = actual[m], predicted[m], repeat_y[m]
+        if m.sum() < 3 or aa.std() == 0 or pp.std() == 0:
+            continue
+        if floors is not None and int(g) in floors:
+            floor = float(floors[int(g)])
+        else:
+            coeffs = np.polyfit(pp, aa, 1)
+            floor = float((aa - np.polyval(coeffs, pp)).std())
+        k_fin = np.sum(np.isfinite(reps), axis=1)
+        decomposable = k_fin >= 2
+        if not decomposable.any():
+            continue  # retrain_times=1: variance undefined per lane
+        with np.errstate(invalid="ignore"):
+            lane_var = np.nanvar(reps[decomposable], axis=1, ddof=1)
+        noise = float(np.sqrt(np.mean(lane_var / k_fin[decomposable])))
+        pred_err = float(np.sqrt(max(floor**2 - noise**2, 0.0)))
+        out[int(g)] = {
+            "floor": floor,
+            "retrain_noise": noise,
+            "prediction_error": pred_err,
+            "noise_share": round(min(noise / floor, 1.0) ** 2, 3)
+            if floor > 0 else float("nan"),
+        }
+    return out
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--npz", nargs="*", default=None)
@@ -103,6 +154,13 @@ def main():
         rep = point_diagnostics(d["actual_loss_diffs"],
                                 d["predicted_loss_diffs"],
                                 d["test_index_of_row"])
+        if "repeat_y" in d.files:
+            rep["noise_decomposition"] = noise_decomposition(
+                d["actual_loss_diffs"], d["predicted_loss_diffs"],
+                d["test_index_of_row"], d["repeat_y"],
+                floors={g: row["resid_std"]
+                        for g, row in rep["per_point"].items()},
+            )
         report[os.path.basename(path)] = rep
         print(f"== {os.path.basename(path)}: floor={rep['floor']:.3e} "
               f"(cv {rep.get('floor_cv', float('nan')):.2f}) "
@@ -111,6 +169,11 @@ def main():
         for g, row in rep["per_point"].items():
             print(f"   t={g:5d} r={row['r']:+.4f} model={row['r_model']:+.4f} "
                   f"std_a={row['std_actual']:.3e} slope={row['slope']:+.3f}")
+        for g, nd in rep.get("noise_decomposition", {}).items():
+            print(f"   t={g:5d} floor={nd['floor']:.3e} = retrain_noise "
+                  f"{nd['retrain_noise']:.3e} (+) prediction_error "
+                  f"{nd['prediction_error']:.3e} "
+                  f"[noise share {nd['noise_share']:.0%}]")
     if os.path.dirname(args.out):
         os.makedirs(os.path.dirname(args.out), exist_ok=True)
     with open(args.out, "w") as fh:

@@ -183,6 +183,11 @@ class TestCLI:
         assert np.isfinite(r)
         art = np.load(tmp_path / "RQ1-MF-synthetic.npz")
         assert set(art["test_index_of_row"]) == {7, 3}
+        # per-repeat retrain outcomes ride in the artifact (r4: the
+        # noise-floor decomposition runs from the npz alone)
+        assert art["repeat_y"].shape == (len(art["actual_loss_diffs"]), 1)
+        assert art["drift_repeat_y"].shape == (2, 1)
+        assert art["y0_of_point"].shape == (2,)
 
     def test_rq1_cli_test_indices_out_of_range(self, tmp_path):
         """A typo'd index must fail in load_splits — BEFORE the training

@@ -45,6 +45,55 @@ class TestFidelitySpread:
             if row["snr"] > 1.5:
                 assert row["model_abs_err"] < 0.05
 
+    def test_noise_decomposition_recovers_planted_split(self):
+        """Plant a known retrain-noise/prediction-error split and check
+        the decomposition recovers both components from the repeats."""
+        mod = _load_script("fidelity_spread")
+        rng = np.random.default_rng(11)
+        K, R = 4, 400
+        sigma_lane, pred_err, y0, bias = 4e-3, 1.5e-3, 3.1, 2e-4
+        a_true = rng.normal(0.0, 1e-2, R)
+        predicted = a_true + rng.normal(0.0, pred_err, R)
+        reps = (y0 + bias + a_true)[:, None] + rng.normal(
+            0.0, sigma_lane, (R, K)
+        )
+        actual = reps.mean(axis=1) - y0 - bias
+        out = mod.noise_decomposition(
+            actual, predicted, np.zeros(R, int), reps
+        )[0]
+        want_noise = sigma_lane / np.sqrt(K)
+        assert abs(out["retrain_noise"] - want_noise) / want_noise < 0.2
+        assert abs(out["prediction_error"] - pred_err) / pred_err < 0.2
+        assert 0.5 < out["noise_share"] < 0.8
+
+    def test_noise_decomposition_nan_repeats(self):
+        """NaN repeats drop per-lane (harness nanmean parity), not
+        poison the estimate."""
+        mod = _load_script("fidelity_spread")
+        rng = np.random.default_rng(5)
+        reps = rng.normal(0.0, 1e-3, (50, 4))
+        reps[::7, 0] = np.nan
+        actual = np.nanmean(reps, axis=1)
+        predicted = actual + rng.normal(0.0, 1e-3, 50)
+        out = mod.noise_decomposition(
+            actual, predicted, np.zeros(50, int), reps
+        )[0]
+        assert np.isfinite(out["retrain_noise"])
+        assert np.isfinite(out["prediction_error"])
+
+    def test_noise_decomposition_skips_single_repeat(self):
+        """retrain_times=1 artifacts have no per-lane variance; the
+        point is skipped, not emitted as NaNs."""
+        mod = _load_script("fidelity_spread")
+        rng = np.random.default_rng(2)
+        reps = rng.normal(0.0, 1e-3, (30, 1))
+        actual = reps[:, 0]
+        predicted = actual + rng.normal(0.0, 1e-3, 30)
+        out = mod.noise_decomposition(
+            actual, predicted, np.zeros(30, int), reps
+        )
+        assert out == {}
+
     def test_degenerate_groups_skipped(self):
         mod = _load_script("fidelity_spread")
         # constant actuals / too-small groups must be skipped, not crash
