@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Merge RQ1 npz artifacts (the companion to ``rq1 --test_indices``).
+
+A truncated multi-point run banks its completed points in the canonical
+``RQ1-<model>-<dataset>.npz``; the resume run re-measures only the
+missing points (``--test_indices``) into a scratch dir or an
+auto-suffixed ``...-pt<idx>.npz``. This utility folds the resume rows
+into the canonical artifact.
+
+Rules: row blocks are keyed by ``test_index_of_row``; a point present
+in several inputs takes the LAST input's rows (so pass the canonical
+artifact first, refreshed points after). The optional per-repeat fields
+(``repeat_y``/``drift_repeat_y``/``y0_of_point``, r4+) survive only if
+EVERY input carries them — mixing old- and new-format inputs drops
+them with a warning rather than fabricating placeholders.
+
+Usage: python scripts/merge_rq1.py --out merged.npz base.npz extra.npz
+"""
+
+import argparse
+import sys
+
+import numpy as np
+
+ROW_FIELDS = ("actual_loss_diffs", "predicted_loss_diffs",
+              "indices_to_remove")
+POINT_FIELDS = ("drift_repeat_y", "y0_of_point")
+
+
+def merge(paths):
+    """dict of merged arrays from npz paths (last-wins per test point)."""
+    points = {}  # test_idx -> {field: rows} in insertion order
+    have_repeats = True
+    for path in paths:
+        d = np.load(path)
+        full_format = {"repeat_y", *POINT_FIELDS} <= set(d.files)
+        if not full_format:
+            have_repeats = False
+        ti = d["test_index_of_row"]
+        uniq = list(dict.fromkeys(int(t) for t in ti))  # file order
+        if full_format and len(uniq) != len(d["drift_repeat_y"]):
+            # a zero-row point (empty related set) appears in the
+            # per-point arrays but not in test_index_of_row; positional
+            # alignment would silently shift every later point's drift
+            # row onto the wrong point
+            raise SystemExit(
+                f"{path}: {len(d['drift_repeat_y'])} per-point rows vs "
+                f"{len(uniq)} distinct test points — cannot align "
+                "per-point repeat fields positionally"
+            )
+        for pi, t in enumerate(uniq):
+            m = ti == t
+            entry = {f: d[f][m] for f in ROW_FIELDS}
+            if full_format:
+                entry["repeat_y"] = d["repeat_y"][m]
+                entry["drift_repeat_y"] = d["drift_repeat_y"][pi]
+                entry["y0_of_point"] = d["y0_of_point"][pi]
+            points[t] = entry  # later files override earlier ones
+    if not points:
+        raise SystemExit("no rows found in any input")
+    if not have_repeats:
+        dropped = any("repeat_y" in e for e in points.values())
+        if dropped:
+            print("WARNING: dropping per-repeat fields — not every "
+                  "input carries them", file=sys.stderr)
+    out = {
+        f: np.concatenate([e[f] for e in points.values()])
+        for f in ROW_FIELDS
+    }
+    out["test_index_of_row"] = np.concatenate([
+        np.full(len(e[ROW_FIELDS[0]]), t, np.int64)
+        for t, e in points.items()
+    ])
+    if have_repeats:
+        out["repeat_y"] = np.concatenate(
+            [e["repeat_y"] for e in points.values()]
+        )
+        out["drift_repeat_y"] = np.stack(
+            [e["drift_repeat_y"] for e in points.values()]
+        )
+        out["y0_of_point"] = np.asarray(
+            [e["y0_of_point"] for e in points.values()], np.float32
+        )
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("inputs", nargs="+")
+    ap.add_argument("--out", required=True)
+    args = ap.parse_args()
+    merged = merge(args.inputs)
+    # atomic write via the same helper the drivers use
+    import os
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from fia_tpu.utils.io import save_npz_atomic
+
+    save_npz_atomic(args.out, **merged)
+    n_pts = len(np.unique(merged["test_index_of_row"]))
+    print(f"wrote {args.out}: {len(merged['actual_loss_diffs'])} rows, "
+          f"{n_pts} points")
+
+
+if __name__ == "__main__":
+    main()

@@ -103,3 +103,58 @@ class TestFidelitySpread:
             np.array([0, 0, 0, 1, 1]),
         )
         assert rep["per_point"] == {}
+
+
+class TestMergeRQ1:
+    """scripts/merge_rq1.py: last-wins point merge with repeat-field
+    preservation rules."""
+
+    def _write(self, path, points, with_repeats=True, K=3, y0_off=0.0):
+        rng = np.random.default_rng(sum(points))
+        rows = {f: [] for f in ("actual_loss_diffs", "predicted_loss_diffs",
+                                "indices_to_remove", "test_index_of_row")}
+        reps, drifts, y0s = [], [], []
+        for t in points:
+            n = 5
+            rows["actual_loss_diffs"].append(rng.normal(size=n))
+            rows["predicted_loss_diffs"].append(rng.normal(size=n))
+            rows["indices_to_remove"].append(np.arange(n))
+            rows["test_index_of_row"].append(np.full(n, t))
+            reps.append(rng.normal(size=(n, K)))
+            drifts.append(rng.normal(size=K))
+            y0s.append(float(t) + y0_off)
+        arrs = {f: np.concatenate(v) for f, v in rows.items()}
+        if with_repeats:
+            arrs |= {"repeat_y": np.concatenate(reps),
+                     "drift_repeat_y": np.stack(drifts),
+                     "y0_of_point": np.asarray(y0s, np.float32)}
+        np.savez(path, **arrs)
+        return arrs
+
+    def test_last_wins_and_repeat_fields_survive(self, tmp_path):
+        mod = _load_script("merge_rq1")
+        self._write(tmp_path / "a.npz", [3, 7])
+        b = self._write(tmp_path / "b.npz", [7, 9], y0_off=0.5)
+        out = mod.merge([str(tmp_path / "a.npz"), str(tmp_path / "b.npz")])
+        assert sorted(set(out["test_index_of_row"])) == [3, 7, 9]
+        # point 7 must carry b's rows AND b's per-point fields
+        # (last input wins; y0_off makes a's and b's y0 distinguishable)
+        m_out = out["test_index_of_row"] == 7
+        m_b = b["test_index_of_row"] == 7
+        np.testing.assert_allclose(
+            out["actual_loss_diffs"][m_out], b["actual_loss_diffs"][m_b]
+        )
+        np.testing.assert_allclose(
+            out["drift_repeat_y"][1], b["drift_repeat_y"][0]
+        )
+        assert out["repeat_y"].shape[0] == len(out["actual_loss_diffs"])
+        assert list(out["y0_of_point"]) == [3.0, 7.5, 9.5]
+
+    def test_mixed_format_drops_repeats(self, tmp_path):
+        mod = _load_script("merge_rq1")
+        self._write(tmp_path / "a.npz", [1], with_repeats=False)
+        self._write(tmp_path / "b.npz", [2], with_repeats=True)
+        out = mod.merge([str(tmp_path / "a.npz"), str(tmp_path / "b.npz")])
+        assert "repeat_y" not in out
+        assert sorted(set(out["test_index_of_row"])) == [1, 2]
+
