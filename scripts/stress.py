@@ -44,6 +44,11 @@ def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--smoke", action="store_true",
                     help="tiny shapes for CI / CPU")
+    ap.add_argument("--model", choices=["MF", "NCF"], default="MF",
+                    help="NCF exercises the GMF+MLP tower at stress "
+                         "scale (twice the embedding params per id; "
+                         "the MLP weights stay outside the FIA block, "
+                         "models/ncf.py)")
     ap.add_argument("--embed_size", type=int, default=16)
     ap.add_argument("--train_steps", type=int, default=2000)
     ap.add_argument("--num_queries", type=int, default=256)
@@ -90,7 +95,7 @@ def main() -> None:
     from fia_tpu.data.synthetic import sample_heldout_pairs, synthesize_ratings
     from fia_tpu.eval.rq2 import time_influence_queries
     from fia_tpu.influence.engine import InfluenceEngine
-    from fia_tpu.models import MF
+    from fia_tpu.models import MF, NCF
     from fia_tpu.train.trainer import Trainer, TrainConfig
     from fia_tpu.utils.logging import EventLog
 
@@ -129,7 +134,8 @@ def main() -> None:
     print(f"stress: synthesized ({args.stream}) in {gen_s:.1f}s",
           file=sys.stderr, flush=True)
 
-    model = MF(users, items, k, weight_decay=1e-3)
+    model_cls = NCF if args.model == "NCF" else MF
+    model = model_cls(users, items, k, weight_decay=1e-3)
     params = model.init_params(jax.random.PRNGKey(args.seed))
 
     mesh = None
@@ -163,21 +169,27 @@ def main() -> None:
     print(f"stress: {steps} train steps in {train_s:.1f}s "
           f"({step_ms:.2f} ms/step)", file=sys.stderr, flush=True)
 
+    # MF keeps the legacy default model_name ("model") so chip-scale
+    # runs reuse the memlimits ceilings already learned under that key;
+    # NCF gets its own key — its memory footprint differs, so sharing
+    # MF's learned envelope would be wrong anyway.
     engine = InfluenceEngine(
         model, state.params, train, damping=1e-6, solver="direct",
         pad_bucket=512, mesh=mesh, shard_tables=shard_tables,
+        **({"model_name": "ncf"} if args.model == "NCF" else {}),
     )
 
     points = sample_heldout_pairs(train.x, users, items, n_q, seed=17)
 
     timing = time_influence_queries(engine, points, repeats=3)
     out = {
-        "metric": f"stress-ml20m-scale influence (MF k={k})",
+        "metric": f"stress-ml20m-scale influence ({args.model} k={k})",
         "value": round(timing.scores_per_sec, 1),
         "unit": "scores/sec",
         "details": {
             "backend": jax.default_backend(),
             "devices": jax.device_count(),
+            "model": args.model,
             "model_parallel": args.model_parallel,
             "users": users, "items": items, "train_rows": rows,
             "train_stream": args.stream,

@@ -129,26 +129,38 @@ class TestCLI:
         ])
         assert timing.num_queries == 3
 
-    def test_stress_driver_smoke(self):
-        """scripts/stress.py (ML-20M stress config, BASELINE.json config 5)
-        runs end-to-end with table sharding on the virtual mesh."""
+    @staticmethod
+    def _run_stress(*flags):
+        """Run scripts/stress.py --smoke with extra flags; parsed JSON.
+        conftest.py already forces JAX_PLATFORMS=cpu and the 8-device
+        virtual mesh into os.environ; the subprocess inherits both."""
         import json
         import os
         import subprocess
         import sys
 
         root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        # conftest.py already forces JAX_PLATFORMS=cpu and the 8-device
-        # virtual mesh into os.environ; the subprocess inherits both.
-        env = dict(os.environ)
         out = subprocess.run(
             [sys.executable, os.path.join(root, "scripts", "stress.py"),
-             "--smoke", "--model_parallel", "2"],
-            capture_output=True, text=True, timeout=300, env=env, cwd=root,
+             "--smoke", *flags],
+            capture_output=True, text=True, timeout=300,
+            env=dict(os.environ), cwd=root,
         )
         assert out.returncode == 0, out.stderr[-2000:]
-        res = json.loads(out.stdout.strip().splitlines()[-1])
+        return json.loads(out.stdout.strip().splitlines()[-1])
+
+    def test_stress_driver_smoke(self):
+        """scripts/stress.py (ML-20M stress config, BASELINE.json config 5)
+        runs end-to-end with table sharding on the virtual mesh."""
+        res = self._run_stress("--model_parallel", "2")
         assert res["details"]["model_parallel"] == 2
+        assert res["value"] > 0
+
+    def test_stress_driver_ncf_smoke(self):
+        """--model NCF runs the stress config on the GMF+MLP tower (r4:
+        the stress scale was MF-only before)."""
+        res = self._run_stress("--model", "NCF")
+        assert res["details"]["model"] == "NCF"
         assert res["value"] > 0
 
     def test_rq1_cli_runs(self, tmp_path):
