@@ -1,0 +1,111 @@
+#!/bin/bash
+# Round-4 chip chain, tier 7 (continuation session, restarted ~09:41
+# UTC Aug 1): REGENERATE the r4 measurement artifacts lost with the
+# previous container. output/ is gitignored; the earlier session
+# banked its rows into BASELINE.md but only `git add -f`-ed a subset
+# of artifacts, and the restart recycled the container — so every r4
+# npz/json cited in BASELINE.md §4 (roofline_*.json, bench previews,
+# ab_impls_*_r4*.json, RQ1-*.npz, fidelity CI inputs, k256 64q logs,
+# ML-20M rows) must be re-measured. Quick perf artifacts first, then
+# the n=8 fidelity matrix + ML-20M; the long full-protocol n=4 runs
+# live in chip_chain_r4h.sh. Each job is idempotent via the banked()
+# marker, so this script can be re-launched after a tunnel outage.
+set -u
+cd "$(dirname "$0")/.."
+CHAIN_TAG=chainR4g
+DEADLINE_EPOCH=$(date -d "2026-08-01 20:30:00 UTC" +%s)
+source "$(dirname "$0")/chain_lib.sh"
+
+echo "chainR4g: $(date) tier 7 starting" >> output/chain.log
+wait_tunnel
+
+# --- tier A: quick perf artifacts (~45 min) ---------------------------
+run_watched "bench preview g1" output/bench_r4g_preview.log \
+  python bench.py --json_out output/bench_r4g_preview.json
+
+run_watched "roofline MF" output/roofline_mf.log \
+  python scripts/roofline.py --model MF --rounds 7 \
+  --out output/roofline_mf.json
+
+run_watched "roofline NCF" output/roofline_ncf.log \
+  python scripts/roofline.py --model NCF --rounds 5 --train_steps 2000 \
+  --out output/roofline_ncf.json
+
+run_watched "impl A/B MF r4g" output/ab_impls_mf_r4.log \
+  python scripts/ab_impls.py --rounds 6 --breakdown --pipeline \
+  --out output/ab_impls_mf_r4.json
+
+run_watched "impl A/B NCF r4g" output/ab_impls_ncf_r4b.log \
+  python scripts/ab_impls.py --rounds 4 --model NCF --train_steps 2000 \
+  --pipeline --out output/ab_impls_ncf_r4b.json
+
+run_watched "RQ2 embed k256 64q as 2x32" output/RQ2_MF_movielens_k256_64q_b32.log \
+  python -m fia_tpu.cli.rq2 --embed_size 256 --dataset movielens --model MF \
+  --data_dir /root/reference/data --train_dir output --num_test 64 \
+  --query_batch 32
+
+run_watched "RQ2 re-measure movielens MF" output/rq2_mf_ml_r4.log \
+  python -m fia_tpu.cli.rq2 --dataset movielens --data_dir /root/reference/data \
+  --train_dir output --model MF --num_test 256
+
+run_watched "RQ2 re-measure movielens NCF" output/rq2_ncf_ml_r4.log \
+  python -m fia_tpu.cli.rq2 --dataset movielens --data_dir /root/reference/data \
+  --train_dir output --model NCF --num_test 256
+
+run_watched "RQ2 re-measure yelp MF" output/rq2_mf_yelp_r4.log \
+  python -m fia_tpu.cli.rq2 --dataset yelp --data_dir /root/reference/data \
+  --train_dir output --model MF --num_test 256
+
+run_watched "RQ2 re-measure yelp NCF" output/rq2_ncf_yelp_r4.log \
+  python -m fia_tpu.cli.rq2 --dataset yelp --data_dir /root/reference/data \
+  --train_dir output --model NCF --num_test 256
+
+echo "chainR4g: $(date) tier A done" >> output/chain.log
+
+# --- tier B: the n=8 fidelity matrix + stress/ML-20M rows -------------
+# These regenerate the RQ1-*.npz artifacts that fidelity_ci.py /
+# fidelity_spread.py post-process. Run n8 first per config so the
+# canonical npz name carries the wide-sample artifact (later runs for
+# the same config divert to -pt-suffixed paths).
+run_watched "MF ML-1M wide-sample n8 (2k x 2)" output/rq1_mf_ml_cal2_n8.log \
+  python -m fia_tpu.cli.rq1 --dataset movielens --data_dir /root/reference/data \
+  --model MF --num_test 8 --num_steps_train 15000 \
+  --num_steps_retrain 2000 --retrain_times 2 --num_to_remove 30 \
+  --batch_size 3020 --lane_chunk 16
+
+run_watched "MF Yelp wide-sample n8 (2k x 2)" output/rq1_mf_yelp_cal2_n8.log \
+  python -m fia_tpu.cli.rq1 --dataset yelp --data_dir /root/reference/data \
+  --model MF --num_test 8 --num_steps_train 15000 \
+  --num_steps_retrain 2000 --retrain_times 2 --num_to_remove 30 \
+  --batch_size 3009 --lane_chunk 16
+
+run_watched "NCF ML-1M wide-sample n8 (2k x 2)" output/rq1_ncf_ml_cal2_n8.log \
+  python -m fia_tpu.cli.rq1 --dataset movielens --data_dir /root/reference/data \
+  --model NCF --num_test 8 --num_steps_train 12000 \
+  --num_steps_retrain 2000 --retrain_times 2 --num_to_remove 30 \
+  --batch_size 3020 --lane_chunk 16 --steps_per_dispatch 1000
+
+run_watched "NCF Yelp wide-sample n8 (2k x 2)" output/rq1_ncf_yelp_cal2_n8.log \
+  python -m fia_tpu.cli.rq1 --dataset yelp --data_dir /root/reference/data \
+  --model NCF --num_test 8 --num_steps_train 12000 \
+  --num_steps_retrain 2000 --retrain_times 2 --num_to_remove 30 \
+  --batch_size 3009 --lane_chunk 16 --steps_per_dispatch 1000
+
+run_watched "stress ML-20M cal + full-space residual" output/stress_ml20m_cal.log \
+  python scripts/stress.py --stream cal --num_queries 128 \
+  --full_space --cg_maxiter 10
+
+run_watched "stress ML-1M converged full-space" output/stress_ml1m_full100.log \
+  python scripts/stress.py --stream cal --users 6040 --items 3706 \
+  --rows 975460 --num_queries 64 --full_space --cg_maxiter 100 \
+  --batch_size 8192
+
+run_watched "RQ1 ML-20M cal (2pt x 30rm x 2k x 2)" output/rq1_mf_ml20m_cal.log \
+  python -m fia_tpu.cli.rq1 --dataset synthetic --synth_stream cal \
+  --synth_users 138493 --synth_items 26744 --synth_train 20000263 \
+  --synth_test 256 --model MF --num_test 2 --num_steps_train 15000 \
+  --num_steps_retrain 2000 --retrain_times 2 --num_to_remove 30 \
+  --batch_size 8192 --lane_chunk 8 --steps_per_dispatch 500
+
+echo "chainR4g: $(date) tier B done" >> output/chain.log
+echo "chainR4g: $(date) tier 7 done" >> output/chain.log

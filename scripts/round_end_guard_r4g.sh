@@ -9,9 +9,10 @@
 # NOT end then — the driver restarted the builder at 07:44 with a
 # fresh 1000-turn budget (PROGRESS.jsonl shows the round already 22h
 # old at that point, so the 12h figure is per-session, not absolute).
-# This guard backstops the CONTINUATION session: 07:44 + ~12h => ends
-# ~19:45; fire at 18:45 for margin. If the round ends earlier the
-# builder frees the chip itself before stopping.
+# This guard backstops the CONTINUATION session. Second restart at
+# ~09:41 UTC Aug 1 (PROGRESS.jsonl wall_s reset again) => ends ~21:41;
+# fire at 20:45 for margin. If the round ends earlier the builder
+# frees the chip itself before stopping.
 #
 # Kill matching: the old guards used `pgrep -f "python.*(...|bench\.py)"`,
 # which MATCHES THE DRIVER'S OWN COMMAND LINE — the claude invocation
@@ -29,7 +30,7 @@ flock -n 9 || exit 0
 
 log() { echo "endguardR4g: $(date) $*" >> output/chain.log; }
 
-DEADLINE_EPOCH=$(date -d "2026-08-01 18:45:00 UTC" +%s)
+DEADLINE_EPOCH=$(date -d "2026-08-01 20:45:00 UTC" +%s)
 now=$(date +%s)
 if [ "$DEADLINE_EPOCH" -gt "$now" ]; then
   sleep $(( DEADLINE_EPOCH - now ))
