@@ -97,6 +97,12 @@ def base_parser(desc: str) -> argparse.ArgumentParser:
                    help="1: synthesize missing train splits calibrated to "
                         "the real valid/test marginals; 0: generic Zipf "
                         "generator (the round-1 measurement stream)")
+    p.add_argument("--cal_rev", choices=["cal2", "cal3"], default="cal2",
+                   help="calibrated-stream revision: cal2 (the r3/r4 "
+                        "measurement stream) or cal3 (saturation-"
+                        "compensated item head, r4); tags flow into "
+                        "checkpoint names so streams never share "
+                        "checkpoints")
     # synthetic scale (used when --dataset synthetic)
     p.add_argument("--synth_users", type=int, default=600)
     p.add_argument("--synth_items", type=int, default=400)
@@ -223,7 +229,8 @@ def load_splits(args):
     else:
         splits = load_dataset(args.dataset, args.data_dir,
                               synthesize_train=True, synth_seed=args.seed,
-                              calibrate=bool(getattr(args, "calibrate", 1)))
+                              calibrate=bool(getattr(args, "calibrate", 1)),
+                              cal_rev=getattr(args, "cal_rev", "cal2"))
         # generator tag flows into checkpoint/model names (model_name_for):
         # a calibrated-split run must never load a Zipf-split checkpoint
         args._synth_tag = getattr(splits["train"], "synth_tag", "")

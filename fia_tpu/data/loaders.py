@@ -50,6 +50,7 @@ def load_dataset(
     synthesize_train: bool = True,
     synth_seed: int = 0,
     calibrate: bool = True,
+    cal_rev: str = "cal2",
 ) -> dict[str, RatingDataset]:
     """Load {train, validation, test} RatingDatasets for a named dataset.
 
@@ -58,6 +59,10 @@ def load_dataset(
     item marginals, constrained lognormal user degrees, heldout-pair
     disjointness — ``synthesize_calibrated``). ``calibrate=False`` keeps
     the generic Zipf(0.8) generator the round-1 measurements used.
+    ``cal_rev`` selects the calibrated-stream revision: ``"cal2"`` (the
+    r3/r4 measurement stream) or ``"cal3"`` (saturation-compensated
+    item head — ``head_fit``). The tag flows into checkpoint names so
+    the two streams can never share checkpoints or influence caches.
     """
     if name not in _SPECS:
         raise ValueError(f"unknown dataset {name!r}; have {sorted(_SPECS)}")
@@ -75,16 +80,20 @@ def load_dataset(
     elif synthesize_train:
         cover = np.concatenate([valid.x, test.x], axis=0)
         if calibrate:
+            if cal_rev not in ("cal2", "cal3"):
+                raise ValueError(f"unknown cal_rev {cal_rev!r}")
             train = synthesize_calibrated(
                 spec["num_users"], spec["num_items"], spec["n_train"],
                 heldout_x=cover, seed=synth_seed,
+                head_fit=(cal_rev == "cal3"),
             )
             # checkpoint/model names key on this tag so calibrated-split
             # checkpoints never collide with the older Zipf-split ones.
             # cal2 = cal1 + intra-train pair dedup + exact-fixed-point
-            # degree floor (ADVICE r2); the r2 rows measured on cal1
-            # stay labelled cal1 in BASELINE.md
-            train.synth_tag = "cal2"
+            # degree floor (ADVICE r2); cal3 = cal2 + saturation-
+            # compensated item head (r4). Rows in BASELINE.md stay
+            # labelled with the stream they were measured on
+            train.synth_tag = cal_rev
         else:
             train = synthesize_ratings(
                 spec["num_users"], spec["num_items"], spec["n_train"],

@@ -154,6 +154,88 @@ class TestReferenceData:
         assert not np.isin(codes_t, codes_h).any()
         assert len(np.unique(codes_t)) == len(codes_t)  # cal2: distinct pairs
 
+    def test_cal3_head_fit_improves_identifiable_marginals(self):
+        """cal3 (r4): saturation-compensated item weights must (a) keep
+        every cal2 structural invariant, (b) recover most of the
+        heldout's top-1% item mass that cal2's smoothed direct draw
+        loses to per-user uniqueness (measured full-scale: 0.072 cal2
+        vs 0.100 cal3 vs 0.108 heldout), and (c) not regress the
+        seen-item rank agreement. Run at full ML-1M scale — the
+        saturation being compensated only exists there."""
+        from fia_tpu.data.loaders import load_movielens
+        from fia_tpu.eval.metrics import spearman
+
+        splits = load_movielens(REF_DATA, cal_rev="cal3")
+        tr = splits["train"]
+        assert getattr(tr, "synth_tag", "") == "cal3"
+        hx = np.concatenate([splits["validation"].x, splits["test"].x])
+        ni = 3_706
+        ic = np.bincount(tr.x[:, 1], minlength=ni)
+        hic = np.bincount(hx[:, 1], minlength=ni)
+        codes_t = tr.x[:, 0].astype(np.int64) * ni + tr.x[:, 1]
+        codes_h = np.unique(hx[:, 0].astype(np.int64) * ni + hx[:, 1])
+        # (a) cal2 invariants all hold on cal3
+        assert len(tr.x) == 975_460
+        assert not np.isin(codes_t, codes_h).any()
+        assert len(np.unique(codes_t)) == len(codes_t)
+        assert not ((hic > 0) & (ic == 0)).any()
+        uc = np.bincount(tr.x[:, 0], minlength=6_040)
+        assert uc.min() >= 16 and uc.max() <= ni - 8
+
+        def top_share(c, frac=0.01):
+            k = max(1, int(len(c) * frac))
+            return np.sort(c)[::-1][:k].sum() / c.sum()
+
+        # (b) head mass: above cal2's measured 0.072, within the
+        # feasibility ceiling of the heldout's 0.108
+        assert 0.09 < top_share(ic) <= 0.11
+        # (c) identifiable rank agreement at least as good as cal2's bar
+        m = hic > 0
+        assert spearman(ic[m], hic[m]) > 0.97
+
+    def test_cal3_weights_deterministic_and_rng_neutral(self):
+        """head_compensated_item_weights consumes no caller rng (cal2
+        reproducibility depends on it) and is deterministic."""
+        from fia_tpu.data.synthetic import (
+            head_compensated_item_weights, synthesize_calibrated,
+        )
+
+        rng = np.random.default_rng(3)
+        ic = rng.integers(0, 50, size=400).astype(np.float64)
+        deg = rng.integers(16, 120, size=300)
+        rows = int(deg.sum())
+        # legacy-global-rng neutrality: the only rng the function could
+        # consume besides its documented private generator is the numpy
+        # global stream; pin it and verify the next draw is unaffected
+        np.random.seed(123)
+        expect = np.random.random()
+        np.random.seed(123)
+        w1 = head_compensated_item_weights(ic, deg, rows)
+        assert np.random.random() == expect
+        w2 = head_compensated_item_weights(ic, deg, rows)
+        np.testing.assert_array_equal(w1, w2)
+        assert abs(w1.sum() - 1.0) < 1e-12
+
+        # cal2 runs stay byte-identical whether or not the cal3 code
+        # path exists: head_fit=False twice, plus head_fit=True to
+        # confirm the flag changes ONLY the item marginal (the user
+        # side — degree profile — is drawn before the branch)
+        held = np.stack([
+            np.arange(64, dtype=np.int64) % 300,
+            np.arange(64, dtype=np.int64) % 400,
+        ], axis=1)
+        a = synthesize_calibrated(300, 400, 12_000, heldout_x=held,
+                                  seed=5, min_degree=8)
+        a2 = synthesize_calibrated(300, 400, 12_000, heldout_x=held,
+                                   seed=5, min_degree=8)
+        np.testing.assert_array_equal(a.x, a2.x)
+        np.testing.assert_array_equal(a.y, a2.y)
+        b = synthesize_calibrated(300, 400, 12_000, heldout_x=held,
+                                  seed=5, min_degree=8, head_fit=True)
+        ua = np.sort(np.bincount(a.x[:, 0], minlength=300))
+        ub = np.sort(np.bincount(b.x[:, 0], minlength=300))
+        np.testing.assert_array_equal(ua, ub)
+
     def test_degree_profile_invariants(self):
         """Two-sided waterfilling: exact total, floor respected with and
         without a ceiling, and the uncapped default path (hi = inf) must
