@@ -1,0 +1,66 @@
+#!/bin/bash
+# Round-4 chip chain, tier 8: runs after chainR4g ("tier 7 done").
+# Two purposes: (1) first fidelity rows on the cal3 stream revision
+# (2k x 2 early-plateau budget, all four reference configs — the
+# cheap matrix that shows the head-compensated stream doesn't move
+# fidelity outside protocol noise), and (2) regenerate the LONG
+# full-protocol artifacts the container restart dropped: the NCF
+# n=4 18k x 4 rows whose per-point values revised the r3 headline
+# (BASELINE §4.2). Per-point values bank into the logs as they
+# complete, so a deadline cut still leaves banked points (the r4f
+# precedent).
+set -u
+cd "$(dirname "$0")/.."
+CHAIN_TAG=chainR4h
+DEADLINE_EPOCH=$(date -d "2026-08-01 20:30:00 UTC" +%s)
+source "$(dirname "$0")/chain_lib.sh"
+
+until grep -q "^chainR4g: .* tier 7 done" output/chain.log; do
+  past_deadline && exit 0
+  sleep 120
+done
+
+echo "chainR4h: $(date) tier 8 starting" >> output/chain.log
+wait_tunnel
+
+# --- cal3 fidelity matrix (2k x 2, 30 removals, 2 points) -------------
+run_watched "cal3 RQ1 MF ML-1M (2k x 2)" output/rq1_mf_ml_cal3_2k2.log \
+  python -m fia_tpu.cli.rq1 --dataset movielens --data_dir /root/reference/data \
+  --model MF --cal_rev cal3 --num_test 2 --num_steps_train 15000 \
+  --num_steps_retrain 2000 --retrain_times 2 --num_to_remove 30 \
+  --batch_size 3020 --lane_chunk 16
+
+run_watched "cal3 RQ1 NCF ML-1M (2k x 2)" output/rq1_ncf_ml_cal3_2k2.log \
+  python -m fia_tpu.cli.rq1 --dataset movielens --data_dir /root/reference/data \
+  --model NCF --cal_rev cal3 --num_test 2 --num_steps_train 12000 \
+  --num_steps_retrain 2000 --retrain_times 2 --num_to_remove 30 \
+  --batch_size 3020 --lane_chunk 16 --steps_per_dispatch 1000
+
+run_watched "cal3 RQ1 MF Yelp (2k x 2)" output/rq1_mf_yelp_cal3_2k2.log \
+  python -m fia_tpu.cli.rq1 --dataset yelp --data_dir /root/reference/data \
+  --model MF --cal_rev cal3 --num_test 2 --num_steps_train 15000 \
+  --num_steps_retrain 2000 --retrain_times 2 --num_to_remove 30 \
+  --batch_size 3009 --lane_chunk 16
+
+run_watched "cal3 RQ1 NCF Yelp (2k x 2)" output/rq1_ncf_yelp_cal3_2k2.log \
+  python -m fia_tpu.cli.rq1 --dataset yelp --data_dir /root/reference/data \
+  --model NCF --cal_rev cal3 --num_test 2 --num_steps_train 12000 \
+  --num_steps_retrain 2000 --retrain_times 2 --num_to_remove 30 \
+  --batch_size 3009 --lane_chunk 16 --steps_per_dispatch 1000
+
+echo "chainR4h: $(date) cal3 matrix done" >> output/chain.log
+
+# --- full-protocol NCF n=4 regenerations ------------------------------
+run_watched "NCF ML-1M full-protocol n4 (18k x 4)" output/rq1_ncf_ml_full_n4.log \
+  python -m fia_tpu.cli.rq1 --dataset movielens --data_dir /root/reference/data \
+  --model NCF --num_test 4 --num_steps_train 12000 \
+  --num_steps_retrain 18000 --retrain_times 4 --num_to_remove 50 \
+  --batch_size 3020 --lane_chunk 16 --steps_per_dispatch 1000
+
+run_watched "NCF Yelp full-protocol n4 (18k x 4)" output/rq1_ncf_yelp_full_n4.log \
+  python -m fia_tpu.cli.rq1 --dataset yelp --data_dir /root/reference/data \
+  --model NCF --num_test 4 --num_steps_train 12000 \
+  --num_steps_retrain 18000 --retrain_times 4 --num_to_remove 50 \
+  --batch_size 3009 --lane_chunk 16 --steps_per_dispatch 1000
+
+echo "chainR4h: $(date) tier 8 done" >> output/chain.log
