@@ -1,0 +1,28 @@
+#!/bin/bash
+# Round-4 chip chain, tier 9: runs after chainR4h ("tier 8 done").
+# The gather-layout microbench (the "data-layout lever" the r4
+# roofline named but did not take — settles whether tile
+# amplification of random k=16 row gathers is a real cost or a
+# cost-model artifact) and a final chip bench preview close to what
+# the driver's BENCH_r04 will run.
+set -u
+cd "$(dirname "$0")/.."
+CHAIN_TAG=chainR4i
+DEADLINE_EPOCH=$(date -d "2026-08-01 20:30:00 UTC" +%s)
+source "$(dirname "$0")/chain_lib.sh"
+
+until grep -q "^chainR4h: .* tier 8 done" output/chain.log; do
+  past_deadline && exit 0
+  sleep 120
+done
+
+echo "chainR4i: $(date) tier 9 starting" >> output/chain.log
+wait_tunnel
+
+run_watched "gather layout A/B" output/gather_layout_ab.log \
+  python scripts/gather_layout_ab.py
+
+run_watched "bench final preview" output/bench_r4g_final.log \
+  python bench.py --json_out output/bench_r4g_final.json
+
+echo "chainR4i: $(date) tier 9 done" >> output/chain.log

@@ -1,0 +1,208 @@
+#!/usr/bin/env python
+"""Gather-layout microbenchmark: is the tile-amplification lever real?
+
+The r4 roofline put the post-optimization flat program at ~85% of the
+XLA-cost-model HBM roofline and attributed the residual ~10x to the
+useful-bytes bound to tile amplification of random k=16 embedding-row
+gathers, naming data-layout work as the one unexploited lever
+(BASELINE §4.3). But the fused row-feature table — 26x less BILLED
+traffic — measured a wash, which suggests the cost model's billed
+bytes are not what the DMA engine actually moves. This microbench
+settles it by timing the SAME workload shape as the flat program's
+gather stage (S random k=16 row reads from a (U, 16) f32 table, each
+folded into a per-row dot so nothing is dead-code-eliminated) under
+four layouts:
+
+  direct   table[idx]                      (the engine's current form)
+  packed   table packed 8 rows/(8,128)-tile; gather the packed tile
+           row, lane-select the 16-lane slice (64 rows/tile -> 8x
+           fewer distinct tiles touched at ML-1M scale)
+  onehot   chunked (chunk, U) bf16 one-hot @ (U, 16) table on the MXU
+           (reads the whole table per chunk, no random access at all)
+  sorted   gather in ascending index order + inverse-permute the
+           result (isolates ACCESS ORDER: if sorting doesn't move the
+           time, query/row bucketing by locality cannot either)
+
+Timing mirrors scripts/roofline.py: interleaved rounds on the same
+arrays, block_until_ready + one-scalar completion probe (the tunnel's
+readiness lie), a null-program baseline subtracted, per-variant minima
+reported with XLA-billed bytes for contrast.
+
+Usage: python scripts/gather_layout_ab.py [--rows 262144] [--rounds 7]
+Writes output/gather_layout_ab.json.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
+import jax
+import jax.numpy as jnp
+
+
+def _cost(compiled):
+    try:
+        c = compiled.cost_analysis()
+        if isinstance(c, (list, tuple)):
+            c = c[0]
+        return float(c.get("flops", 0.0)), float(
+            c.get("bytes accessed", 0.0)
+        )
+    except Exception:
+        return 0.0, 0.0
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--users", type=int, default=6_040)
+    ap.add_argument("--k", type=int, default=16)
+    ap.add_argument("--rows", type=int, default=262_144,
+                    help="flat gather count (the MF 256-query s_pad)")
+    ap.add_argument("--rounds", type=int, default=7)
+    ap.add_argument("--chunk", type=int, default=8_192,
+                    help="one-hot matmul chunk")
+    ap.add_argument("--out", default="output/gather_layout_ab.json")
+    args = ap.parse_args()
+
+    U, K, S = args.users, args.k, args.rows
+    PACK = 128 // K  # rows per 128-lane tile row
+    rng = np.random.default_rng(0)
+    table_np = rng.normal(size=(U, K)).astype(np.float32)
+    # pad U to a multiple of PACK for the packed layout
+    Upad = ((U + PACK - 1) // PACK) * PACK
+    table = jnp.asarray(table_np)
+    packed = jnp.asarray(
+        np.concatenate(
+            [table_np, np.zeros((Upad - U, K), np.float32)]
+        ).reshape(Upad // PACK, PACK * K)
+    )
+    # per-row fold vectors: a dot per gathered row, so every variant
+    # must materialize the same (S, K) values
+    fold = jnp.asarray(rng.normal(size=(S, K)).astype(np.float32))
+    idxs = [
+        jnp.asarray(rng.integers(0, U, size=S).astype(np.int32))
+        for _ in range(args.rounds)
+    ]
+
+    def direct(idx):
+        return jnp.sum(table[idx] * fold)
+
+    def packed_fn(idx):
+        rowsel = packed[idx // PACK].reshape(-1, PACK, K)
+        g = jnp.take_along_axis(
+            rowsel, (idx % PACK)[:, None, None], axis=1
+        )[:, 0, :]
+        return jnp.sum(g * fold)
+
+    def onehot(idx):
+        tb = table.astype(jnp.bfloat16)
+        nchunk = S // args.chunk
+
+        def body(acc, args_):
+            ci, cf = args_
+            oh = (
+                ci[:, None] == jnp.arange(U, dtype=jnp.int32)[None, :]
+            ).astype(jnp.bfloat16)
+            g = (oh @ tb).astype(jnp.float32)
+            return acc + jnp.sum(g * cf), None
+
+        acc, _ = jax.lax.scan(
+            body,
+            jnp.zeros((), jnp.float32),
+            (
+                idx[: nchunk * args.chunk].reshape(nchunk, args.chunk),
+                fold[: nchunk * args.chunk].reshape(
+                    nchunk, args.chunk, K
+                ),
+            ),
+        )
+        return acc
+
+    def sorted_fn(idx):
+        order = jnp.argsort(idx)
+        g = table[idx[order]]
+        inv = jnp.zeros_like(order).at[order].set(
+            jnp.arange(S, dtype=order.dtype)
+        )
+        return jnp.sum(g[inv] * fold)
+
+    null_fn = jax.jit(lambda idx: jnp.sum(idx))
+    variants = {
+        "direct": jax.jit(direct),
+        "packed": jax.jit(packed_fn),
+        "onehot": jax.jit(onehot),
+        "sorted": jax.jit(sorted_fn),
+    }
+    billed = {}
+    for name, fn in variants.items():
+        compiled = fn.lower(idxs[0]).compile()
+        billed[name] = _cost(compiled)[1]
+        jax.block_until_ready(fn(idxs[0]))  # warm
+        print(f"gather_ab: compiled {name}, billed "
+              f"{billed[name] / 1e9:.2f} GB", file=sys.stderr, flush=True)
+    jax.block_until_ready(null_fn(idxs[0]))
+
+    times = {k: [] for k in variants}
+    nulls = []
+    for r in range(args.rounds):
+        t0 = time.perf_counter()
+        float(null_fn(idxs[r]))
+        nulls.append(time.perf_counter() - t0)
+        for name, fn in variants.items():
+            t0 = time.perf_counter()
+            out = fn(idxs[r])
+            jax.block_until_ready(out)
+            float(out)  # completion probe (tunnel readiness lie)
+            times[name].append(time.perf_counter() - t0)
+
+    null_s = min(nulls)
+    useful_gb = S * K * 4 / 1e9
+    res = {
+        "backend": jax.default_backend(),
+        "users": U, "k": K, "rows": S, "rounds": args.rounds,
+        "null_dispatch_s": round(null_s, 5),
+        "useful_gb": round(useful_gb, 4),
+        "variants": {},
+    }
+    for name in variants:
+        dev = max(min(times[name]) - null_s, 1e-9)
+        res["variants"][name] = {
+            "best_s": round(min(times[name]), 5),
+            "device_s_minus_null": round(dev, 5),
+            "billed_gb": round(billed[name] / 1e9, 3),
+            "useful_gb_per_s": round(useful_gb / dev, 2),
+            "all_s": [round(t, 5) for t in times[name]],
+        }
+        print(f"gather_ab: {name}: best {min(times[name]):.5f} s "
+              f"(-null {dev:.5f}), billed {billed[name] / 1e9:.2f} GB",
+              flush=True)
+    # agreement check: all variants fold to the same scalar
+    vals = {n: float(v(idxs[0])) for n, v in variants.items()}
+    ref = vals["direct"]
+    for n, v in vals.items():
+        tol = 0.35 if n == "onehot" else 1e-3  # bf16 one-hot path
+        assert abs(v - ref) <= tol * max(1.0, abs(ref)), (n, v, ref)
+    res["agreement"] = {
+        n: round(v, 3) for n, v in vals.items()
+    }
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(res, f, indent=2)
+
+
+if __name__ == "__main__":
+    main()
