@@ -1,0 +1,34 @@
+#!/bin/bash
+# Round-4 chip chain, tier 10: dispatch-amortization scaling. The r4
+# roofline showed the sequential e2e path is bound by the ~0.18 s
+# fixed tunnel dispatch overhead, not the ~0.1 s device program —
+# so a larger query batch should buy near-linear throughput until the
+# device program dominates. Measures the flat path at 512/1024/2048-
+# query dispatches (the bench's 256 stays the cross-round comparable).
+set -u
+cd "$(dirname "$0")/.."
+CHAIN_TAG=chainR4j
+DEADLINE_EPOCH=$(date -d "2026-08-01 20:30:00 UTC" +%s)
+source "$(dirname "$0")/chain_lib.sh"
+
+until grep -q "^chainR4i: .* tier 9 done" output/chain.log; do
+  past_deadline && exit 0
+  sleep 120
+done
+
+echo "chainR4j: $(date) tier 10 starting" >> output/chain.log
+wait_tunnel
+
+run_watched "impl A/B MF 512q" output/ab_impls_mf_512q.log \
+  python scripts/ab_impls.py --rounds 4 --batch_queries 512 \
+  --out output/ab_impls_mf_512q.json
+
+run_watched "impl A/B MF 1024q" output/ab_impls_mf_1024q.log \
+  python scripts/ab_impls.py --rounds 4 --batch_queries 1024 \
+  --out output/ab_impls_mf_1024q.json
+
+run_watched "impl A/B MF 2048q" output/ab_impls_mf_2048q.log \
+  python scripts/ab_impls.py --rounds 4 --batch_queries 2048 \
+  --out output/ab_impls_mf_2048q.json
+
+echo "chainR4j: $(date) tier 10 done" >> output/chain.log
