@@ -246,17 +246,24 @@ def batch_size_for(args, train) -> int:
     return max(1, min(3000, train.num_examples // 10))
 
 
-def model_name_for(args, wd=None, splits=None) -> str:
-    """Checkpoint/model-name key. Pass ``splits`` whenever they are in
-    hand: the train split's generator tag (e.g. 'cal1') is read directly
-    from it, so the name cannot silently drop the tag when a caller
-    never went through load_splits (which stashes the same tag on args
-    as a fallback for split-free paths)."""
-    wd = args.weight_decay if wd is None else wd
+def synth_tag_for(args, splits=None) -> str:
+    """The train stream's generator tag ('cal2', 'cal3', 'calsynth',
+    '' for real/Zipf streams). Pass ``splits`` whenever they are in
+    hand: the tag is read directly from the train split, so it cannot
+    silently drop when a caller never went through load_splits (which
+    stashes the same tag on args as a fallback for split-free paths).
+    The single resolver for checkpoint names AND artifact provenance —
+    two sites disagreeing here would let a cal3 run load a cal2
+    checkpoint or clobber its artifact."""
     if splits is not None:
-        tag = getattr(splits["train"], "synth_tag", "")
-    else:
-        tag = getattr(args, "_synth_tag", "")
+        return getattr(splits["train"], "synth_tag", "")
+    return getattr(args, "_synth_tag", "")
+
+
+def model_name_for(args, wd=None, splits=None) -> str:
+    """Checkpoint/model-name key (see synth_tag_for on the tag)."""
+    wd = args.weight_decay if wd is None else wd
+    tag = synth_tag_for(args, splits)
     return (
         f"{args.dataset}_{args.model}_explicit_damping{args.damping:.0e}"
         f"_avextol{args.avextol:.0e}_embed{args.embed_size}"

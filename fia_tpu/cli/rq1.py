@@ -19,6 +19,47 @@ from fia_tpu.cli import common
 from fia_tpu.utils.io import save_npz_atomic
 
 
+def artifact_path(train_dir, model, dataset, args, test_indices, tag):
+    """Where this run banks its npz rows.
+
+    The canonical reference-shaped name is RQ1-<model>-<dataset>.npz.
+    Two divert rules keep hours of banked chip time safe from
+    clobbering:
+
+    - ``--test_indices`` resume runs next to an existing artifact
+      divert to a -pt<ids> suffix (merge via scripts/merge_rq1.py).
+    - Any other run that finds an existing artifact written under a
+      DIFFERENT protocol or train stream (retrain budget, removals,
+      num_test, maxinf, seed, stream tag — stored in the npz since r4)
+      diverts to a protocol-suffixed name. Same-protocol re-runs still overwrite in
+      place, which is what makes chain retries idempotent. Artifacts
+      predating the protocol fields are treated as different (divert).
+    """
+    canonical = os.path.join(train_dir, f"RQ1-{model}-{dataset}.npz")
+    if not os.path.exists(canonical):
+        return canonical
+    if args.test_indices:
+        suffix = "-".join(str(int(t)) for t in test_indices)
+        return os.path.join(
+            train_dir, f"RQ1-{model}-{dataset}-pt{suffix}.npz"
+        )
+    proto = (args.num_steps_retrain, args.retrain_times,
+             args.num_to_remove, args.num_test, int(args.maxinf),
+             args.seed, tag or "")
+    try:
+        with np.load(canonical, allow_pickle=False) as z:
+            old = tuple(z["protocol"]) + (str(z["stream_tag"]),)
+    except Exception:
+        old = None
+    if old == (*(int(x) for x in proto[:6]), proto[6]):
+        return canonical
+    pstr = (f"{'' if not proto[6] else proto[6] + '-'}"
+            f"r{proto[0]}x{proto[1]}n{proto[3]}rm{proto[2]}"
+            + (f"-maxinf" if proto[4] else "")
+            + (f"-seed{proto[5]}" if proto[5] else ""))
+    return os.path.join(train_dir, f"RQ1-{model}-{dataset}-{pstr}.npz")
+
+
 def main(argv=None):
     args = common.base_parser(__doc__).parse_args(argv)
     common.apply_backend(args)
@@ -52,19 +93,16 @@ def main(argv=None):
     test_indices = common.pick_test_points(args, splits, engine.index)
     print(f"test indices: {list(map(int, test_indices))}")
 
-    # Resume runs (--test_indices) must not clobber a truncated run's
-    # banked artifact in the same train_dir: divert to a suffixed path
-    # when the canonical artifact already exists (merge is a cheap
-    # post-processing step; re-banking hours of chip time is not)
-    art_path = os.path.join(
-        args.train_dir, f"RQ1-{args.model}-{args.dataset}.npz"
+    # Never clobber a banked artifact from a different run: resume
+    # runs and different-protocol/stream runs divert to suffixed
+    # paths; only same-protocol re-runs overwrite (idempotent chain
+    # retries). See artifact_path.
+    tag = common.synth_tag_for(args, splits)
+    art_path = artifact_path(
+        args.train_dir, args.model, args.dataset, args, test_indices, tag
     )
-    if args.test_indices and os.path.exists(art_path):
-        suffix = "-".join(str(int(t)) for t in test_indices)
-        art_path = os.path.join(
-            args.train_dir, f"RQ1-{args.model}-{args.dataset}-pt{suffix}.npz"
-        )
-        print(f"existing artifact kept; resume rows -> {art_path}")
+    if os.path.basename(art_path) != f"RQ1-{args.model}-{args.dataset}.npz":
+        print(f"existing artifact kept; rows -> {art_path}")
 
     actuals, predictions, removed = [], [], []
     repeat_rows, drift_rows, y0s = [], [], []
@@ -112,6 +150,14 @@ def main(argv=None):
             repeat_y=np.concatenate(repeat_rows),
             drift_repeat_y=np.stack(drift_rows),
             y0_of_point=np.asarray(y0s, np.float32),
+            # provenance (r4): lets artifact_path distinguish a
+            # same-protocol re-run (overwrite) from a different run
+            # (divert), and lets post-processing label rows
+            protocol=np.asarray([args.num_steps_retrain,
+                                 args.retrain_times, args.num_to_remove,
+                                 args.num_test, int(args.maxinf),
+                                 args.seed], np.int64),
+            stream_tag=np.asarray(tag),
         )
 
     a = np.concatenate(actuals)

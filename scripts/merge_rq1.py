@@ -31,8 +31,13 @@ def merge(paths):
     """dict of merged arrays from npz paths (last-wins per test point)."""
     points = {}  # test_idx -> {field: rows} in insertion order
     have_repeats = True
+    provenances = []  # (protocol tuple, stream tag) per input, or None
     for path in paths:
         d = np.load(path)
+        provenances.append(
+            (tuple(int(x) for x in d["protocol"]), str(d["stream_tag"]))
+            if {"protocol", "stream_tag"} <= set(d.files) else None
+        )
         full_format = {"repeat_y", *POINT_FIELDS} <= set(d.files)
         if not full_format:
             have_repeats = False
@@ -81,6 +86,18 @@ def merge(paths):
         out["y0_of_point"] = np.asarray(
             [e["y0_of_point"] for e in points.values()], np.float32
         )
+    # provenance (r4): carry protocol/stream_tag through ONLY when every
+    # input agrees — then the merged canonical still authorizes
+    # same-protocol in-place overwrites (cli/rq1.artifact_path). A mixed
+    # or legacy merge drops them, which downgrades the artifact to
+    # "always divert" — the safe direction.
+    if provenances and all(p is not None and p == provenances[0]
+                           for p in provenances):
+        out["protocol"] = np.asarray(provenances[0][0], np.int64)
+        out["stream_tag"] = np.asarray(provenances[0][1])
+    elif any(p is not None for p in provenances):
+        print("WARNING: dropping protocol/stream_tag — inputs disagree "
+              "or some predate provenance", file=sys.stderr)
     return out
 
 

@@ -158,3 +158,101 @@ class TestMergeRQ1:
         assert "repeat_y" not in out
         assert sorted(set(out["test_index_of_row"])) == [1, 2]
 
+
+
+class TestRQ1ArtifactPath:
+    """cli/rq1.artifact_path: the no-clobber rules that keep banked
+    chip-time artifacts safe when one train_dir hosts runs under
+    several protocols and stream revisions (chain tiers B/8)."""
+
+    def _args(self, **kw):
+        import argparse
+
+        d = dict(test_indices=None, num_steps_retrain=2000,
+                 retrain_times=2, num_to_remove=30, num_test=8,
+                 maxinf=0, seed=0)
+        d.update(kw)
+        return argparse.Namespace(**d)
+
+    def _bank(self, path, args, tag=""):
+        np.savez(path,
+                 protocol=np.asarray([args.num_steps_retrain,
+                                      args.retrain_times,
+                                      args.num_to_remove,
+                                      args.num_test, int(args.maxinf),
+                                      args.seed], np.int64),
+                 stream_tag=np.asarray(tag))
+
+    def test_rules(self, tmp_path):
+        from fia_tpu.cli.rq1 import artifact_path
+
+        td = str(tmp_path)
+        a = self._args()
+        canon = os.path.join(td, "RQ1-MF-movielens.npz")
+        # empty dir: canonical
+        assert artifact_path(td, "MF", "movielens", a, [1, 2], "cal2") \
+            == canon
+        # same protocol + tag banked: overwrite in place (idempotent
+        # chain retry)
+        self._bank(canon, a, "cal2")
+        assert artifact_path(td, "MF", "movielens", a, [1, 2], "cal2") \
+            == canon
+        # different protocol: divert, name carries tag + protocol
+        b = self._args(num_steps_retrain=18000, retrain_times=4,
+                       num_to_remove=50, num_test=4)
+        p = artifact_path(td, "MF", "movielens", b, [1, 2], "cal2")
+        assert p == os.path.join(
+            td, "RQ1-MF-movielens-cal2-r18000x4n4rm50.npz")
+        # different stream, same protocol: divert
+        p = artifact_path(td, "MF", "movielens", a, [1, 2], "cal3")
+        assert "cal3" in os.path.basename(p) and p != canon
+        # maxinf / seed flips are protocol changes too (the removal
+        # sampling differs): divert, never overwrite
+        p = artifact_path(td, "MF", "movielens",
+                          self._args(maxinf=1), [1, 2], "cal2")
+        assert "maxinf" in os.path.basename(p) and p != canon
+        p = artifact_path(td, "MF", "movielens",
+                          self._args(seed=3), [1, 2], "cal2")
+        assert "seed3" in os.path.basename(p) and p != canon
+        # explicit resume indices: pt-divert wins over protocol match
+        c = self._args(test_indices=[5, 9])
+        assert artifact_path(td, "MF", "movielens", c, [5, 9], "cal2") \
+            == os.path.join(td, "RQ1-MF-movielens-pt5-9.npz")
+        # legacy artifact without provenance fields: treated as a
+        # different run (divert, never clobber)
+        legacy = os.path.join(td, "RQ1-NCF-yelp.npz")
+        np.savez(legacy, actual_loss_diffs=np.zeros(3))
+        p = artifact_path(td, "NCF", "yelp", a, [1], "cal2")
+        assert p != legacy
+
+    def test_merge_carries_provenance_when_inputs_agree(self, tmp_path):
+        import importlib.util as _il
+
+        spec = _il.spec_from_file_location(
+            "merge_rq1", os.path.join(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                "scripts", "merge_rq1.py"))
+        mod = _il.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        proto = np.asarray([2000, 2, 30, 8, 0, 0], np.int64)
+
+        def write(path, t, tag="cal2", with_prov=True):
+            arrs = dict(
+                actual_loss_diffs=np.ones(3), predicted_loss_diffs=np.ones(3),
+                indices_to_remove=np.arange(3),
+                test_index_of_row=np.full(3, t),
+            )
+            if with_prov:
+                arrs |= dict(protocol=proto, stream_tag=np.asarray(tag))
+            np.savez(path, **arrs)
+
+        write(tmp_path / "a.npz", 1)
+        write(tmp_path / "b.npz", 2)
+        out = mod.merge([str(tmp_path / "a.npz"), str(tmp_path / "b.npz")])
+        assert tuple(out["protocol"]) == tuple(proto)
+        assert str(out["stream_tag"]) == "cal2"
+        # disagreement (or a legacy input) drops provenance -> the
+        # merged artifact downgrades to always-divert
+        write(tmp_path / "c.npz", 3, with_prov=False)
+        out = mod.merge([str(tmp_path / "a.npz"), str(tmp_path / "c.npz")])
+        assert "protocol" not in out and "stream_tag" not in out
