@@ -88,6 +88,17 @@ def main():
             "per_point": per_point,
             "bootstrap_draws": args.B,
         }
+        # provenance labels (r4): artifacts written since the
+        # protocol/stream fields landed self-describe their run
+        if "protocol" in d.files:
+            steps, times, rm, ntest, maxinf, seed = (
+                int(x) for x in d["protocol"])
+            entry["protocol"] = {
+                "retrain_steps": steps, "retrain_times": times,
+                "removals": rm, "num_test": ntest,
+                "maxinf": maxinf, "seed": seed,
+                "stream": str(d["stream_tag"]),
+            }
         result[os.path.basename(f)] = entry
         print(f"{os.path.basename(f)}: pooled r = {pooled:.4f} "
               f"[{lo:.4f}, {hi:.4f}] over {len(a)} rows / "
