@@ -897,6 +897,21 @@ class InfluenceEngine:
                 self._query_flat(test_points[h:], pad_to, _depth + 1),
             ])
 
+    def _wide_block_cap(self) -> bool:
+        """Proactive dispatch cap for very wide blocks: the d=514
+        (k=256) 64-query flat program kills the TPU worker outright (a
+        runtime/kernel fault, not an XLA OOM — reproduced 6x across
+        r3/r4, BASELINE §4.1) while 32-query dispatches are measured
+        safe, and k=128 at 256 queries is fine, so the guard keys on
+        block width alone. The reactive crash recovery (worker-
+        signature classify -> state rebuild -> retry-at-half) still
+        absorbs anything the cap misses, but a production k=256 sweep
+        should not have to crash twice to find the safe size. Scoped
+        to the TPU backend and the flat path, the only territory the
+        fault was ever observed in."""
+        return (int(self.model.block_size) >= 512
+                and jax.default_backend() == "tpu")
+
     def query_many(
         self,
         test_points: np.ndarray,
@@ -917,6 +932,8 @@ class InfluenceEngine:
         test_points = np.asarray(test_points)
         if test_points.ndim == 1:
             test_points = test_points[None, :]
+        if self._wide_block_cap():
+            batch_queries = min(batch_queries, 32)
         batches = [
             test_points[i : i + batch_queries]
             for i in range(0, len(test_points), batch_queries)
@@ -1030,6 +1047,16 @@ class InfluenceEngine:
         T = test_points.shape[0]
 
         if self.impl in ("auto", "flat") and self._flat_eligible():
+            if self._wide_block_cap() and T > 32:
+                # Ride query_many's windowed pipeline (overlapped
+                # dispatch/fetch + its own crash fallback) rather than
+                # serialize 32-query fetch cycles here; sub-results
+                # stitch across differing pads (_concat_results takes
+                # the max).
+                return _concat_results(
+                    self.query_many(test_points, batch_queries=32,
+                                    pad_to=pad_to)
+                )
             return self._query_flat(test_points, pad_to)
         if self.impl == "flat":
             raise ValueError(

@@ -90,7 +90,7 @@ def main():
         }
         # provenance labels (r4): artifacts written since the
         # protocol/stream fields landed self-describe their run
-        if "protocol" in d.files:
+        if {"protocol", "stream_tag"} <= set(d.files):
             steps, times, rm, ntest, maxinf, seed = (
                 int(x) for x in d["protocol"])
             entry["protocol"] = {

@@ -903,6 +903,51 @@ class TestAdaptiveChunking:
         r = big.query_batch(self.PTS[:2])
         assert np.isfinite(r.ihvp).all()
 
+    def test_wide_block_dispatch_cap_is_proactive(self, model_cls,
+                                                  monkeypatch):
+        """d >= 512 on the TPU backend must pre-split flat dispatches
+        into 32-query windows (the measured-safe size for the k=256
+        kernel fault, BASELINE §4.1) instead of relying on the crash-
+        recovery path, and the stitched result must equal an uncapped
+        run."""
+        if model_cls is not MF:
+            return
+        rng = np.random.default_rng(1)
+        n = 400
+        x = np.stack([rng.integers(0, U, n), rng.integers(0, I, n)],
+                     axis=1).astype(np.int32)
+        y = rng.integers(1, 6, n).astype(np.float32)
+        train = RatingDataset(x, y)
+        model = MF(U, I, 255, WD)  # block 2k+2 = 512
+        params = model.init_params(jax.random.PRNGKey(0))
+        pts = np.stack([rng.integers(0, U, 40), rng.integers(0, I, 40)],
+                       axis=1).astype(np.int32)
+
+        base = InfluenceEngine(model, params, train, damping=DAMP,
+                               impl="flat").query_batch(pts)
+        eng = InfluenceEngine(model, params, train, damping=DAMP,
+                              impl="flat")
+        calls = []
+        real = eng._dispatch_flat
+
+        def spy(tp, pad_to):
+            calls.append(len(tp))
+            return real(tp, pad_to)
+
+        eng._dispatch_flat = spy
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        res = eng.query_batch(pts)
+        assert calls == [32, 8]
+        assert np.array_equal(res.counts, base.counts)
+        for t in range(len(pts)):
+            np.testing.assert_allclose(res.scores_of(t), base.scores_of(t),
+                                       rtol=1e-4, atol=1e-6)
+        # query_many must cap its own batching too (the sweep's
+        # 64-query protocol path)
+        calls.clear()
+        many = eng.query_many(pts, batch_queries=64)
+        assert calls == [32, 8] and len(many) == 2
+
     def test_concat_dense_branch(self, model_cls):
         from fia_tpu.influence.engine import InfluenceResult, _concat_results
 
