@@ -1,0 +1,33 @@
+#!/bin/bash
+# Round-4 chip chain, tier 11 (tail): extend the NCF ML-1M
+# FULL-protocol (18k x 4) sample from n=4 to n=8 — the honest
+# population estimate (≈0.88–0.92, per-point spread ~0.24) currently
+# rests on 6 sampled points; these are points 5-8 in the seed-17
+# order (the same indices the n=8 wide-sample row measured at 2k x 2,
+# so the budget ladder gets per-point pairs too). Runs last: per-point
+# values bank into the log as they complete, so a deadline cut still
+# leaves usable points. The --test_indices run auto-diverts its npz
+# (cli/rq1.artifact_path) and merges via scripts/merge_rq1.py.
+set -u
+cd "$(dirname "$0")/.."
+CHAIN_TAG=chainR4k
+DEADLINE_EPOCH=$(date -d "2026-08-01 20:30:00 UTC" +%s)
+source "$(dirname "$0")/chain_lib.sh"
+
+until grep -q "^chainR4j: .* tier 10 done" output/chain.log; do
+  past_deadline && exit 0
+  sleep 120
+done
+
+echo "chainR4k: $(date) tier 11 starting" >> output/chain.log
+wait_tunnel
+
+run_watched "NCF ML-1M full-protocol pts 5-8 (18k x 4)" \
+  output/rq1_ncf_ml_full_pts5to8.log \
+  python -m fia_tpu.cli.rq1 --dataset movielens --data_dir /root/reference/data \
+  --model NCF --num_test 8 --test_indices 3715 3256 494 7686 \
+  --num_steps_train 12000 --num_steps_retrain 18000 --retrain_times 4 \
+  --num_to_remove 50 --batch_size 3020 --lane_chunk 16 \
+  --steps_per_dispatch 1000
+
+echo "chainR4k: $(date) tier 11 done" >> output/chain.log
