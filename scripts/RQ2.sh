@@ -1,6 +1,10 @@
 #!/bin/sh
 # RQ2 time-cost sweep over embedding sizes 8..256 (the sweep the
 # reference's RQ2.sh intended but silently dropped; SURVEY.md §2.3).
+# k=256 needs no special casing since r4: the engine pre-splits
+# wide-block (d >= 512) TPU dispatches into the measured-safe
+# 32-query windows itself (the 64-query d=514 program kills the TPU
+# worker — BASELINE §4.1).
 set -e
 cd "$(dirname "$0")/.."
 DATA=${DATA:-/root/reference/data}
