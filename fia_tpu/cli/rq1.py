@@ -19,45 +19,82 @@ from fia_tpu.cli import common
 from fia_tpu.utils.io import save_npz_atomic
 
 
-def artifact_path(train_dir, model, dataset, args, test_indices, tag):
+def artifact_path(train_dir, model, dataset, args, test_indices, tag,
+                  model_key=""):
     """Where this run banks its npz rows.
 
     The canonical reference-shaped name is RQ1-<model>-<dataset>.npz.
     Two divert rules keep hours of banked chip time safe from
     clobbering:
 
-    - ``--test_indices`` resume runs next to an existing artifact
-      divert to a -pt<ids> suffix (merge via scripts/merge_rq1.py).
+    - ``--test_indices`` resume runs always divert to a -pt<ids>
+      suffix (merge via scripts/merge_rq1.py); an occupied -pt path
+      banked under a different protocol/config ladders further to
+      -pt<ids>-<protocol>[-m<digest>] instead of clobbering.
     - Any other run that finds an existing artifact written under a
-      DIFFERENT protocol or train stream (retrain budget, removals,
-      num_test, maxinf, seed, stream tag — stored in the npz since r4)
-      diverts to a protocol-suffixed name. Same-protocol re-runs still overwrite in
+      DIFFERENT protocol, train stream, or model config diverts to a
+      protocol-suffixed name. "Same protocol" covers the retrain
+      budget, removals, num_test, maxinf, seed, stream tag (stored in
+      the npz since r4) AND, since r5, a model_key folding in the
+      training hyperparameters (num_steps_train, lr, embed_size,
+      damping, weight_decay via common.model_name_for) — runs
+      differing only in those used to compare equal and overwrite the
+      canonical artifact in place despite measuring different
+      influence values. Same-protocol re-runs still overwrite in
       place, which is what makes chain retries idempotent. Artifacts
-      predating the protocol fields are treated as different (divert).
+      predating any provenance field are treated as different
+      (divert).
     """
-    canonical = os.path.join(train_dir, f"RQ1-{model}-{dataset}.npz")
-    if not os.path.exists(canonical):
-        return canonical
-    if args.test_indices:
-        suffix = "-".join(str(int(t)) for t in test_indices)
-        return os.path.join(
-            train_dir, f"RQ1-{model}-{dataset}-pt{suffix}.npz"
-        )
     proto = (args.num_steps_retrain, args.retrain_times,
              args.num_to_remove, args.num_test, int(args.maxinf),
              args.seed, tag or "")
-    try:
-        with np.load(canonical, allow_pickle=False) as z:
-            old = tuple(z["protocol"]) + (str(z["stream_tag"]),)
-    except Exception:
-        old = None
-    if old == (*(int(x) for x in proto[:6]), proto[6]):
-        return canonical
+
+    def occupied_by_other(path):
+        """True when ``path`` exists and was banked by a run with a
+        different protocol, stream, or model config (or predates the
+        provenance fields — treated as different, never clobbered)."""
+        if not os.path.exists(path):
+            return False
+        try:
+            with np.load(path, allow_pickle=False) as z:
+                old = tuple(z["protocol"]) + (str(z["stream_tag"]),)
+                old_key = (str(z["model_key"]) if "model_key" in z.files
+                           else None)
+        except Exception:
+            return True
+        return not (old == (*(int(x) for x in proto[:6]), proto[6])
+                    and old_key == model_key)
+
     pstr = (f"{'' if not proto[6] else proto[6] + '-'}"
             f"r{proto[0]}x{proto[1]}n{proto[3]}rm{proto[2]}"
             + (f"-maxinf" if proto[4] else "")
             + (f"-seed{proto[5]}" if proto[5] else ""))
-    return os.path.join(train_dir, f"RQ1-{model}-{dataset}-{pstr}.npz")
+
+    def digested(path):
+        import hashlib
+
+        digest = hashlib.sha1(model_key.encode()).hexdigest()[:8]
+        return path[: -len(".npz")] + f"-m{digest}.npz"
+
+    canonical = os.path.join(train_dir, f"RQ1-{model}-{dataset}.npz")
+    if args.test_indices:
+        # resume runs never claim the canonical name; their -pt path
+        # gets the same occupied-by-other laddering as any divert
+        # (two resumes at the same indices but different retrain
+        # protocol or training config must not clobber each other)
+        suffix = "-".join(str(int(t)) for t in test_indices)
+        pt = os.path.join(train_dir, f"RQ1-{model}-{dataset}-pt{suffix}.npz")
+        if not occupied_by_other(pt):
+            return pt
+        ptp = pt[: -len(".npz")] + f"-{pstr}.npz"
+        return ptp if not occupied_by_other(ptp) else digested(ptp)
+    if not os.path.exists(canonical) or not occupied_by_other(canonical):
+        return canonical
+    divert = os.path.join(train_dir, f"RQ1-{model}-{dataset}-{pstr}.npz")
+    # the divert name encodes the retrain protocol but not the model
+    # config; two same-protocol runs differing only in training
+    # hyperparameters would compute the SAME divert path
+    return divert if not occupied_by_other(divert) else digested(divert)
 
 
 def main(argv=None):
@@ -98,8 +135,15 @@ def main(argv=None):
     # paths; only same-protocol re-runs overwrite (idempotent chain
     # retries). See artifact_path.
     tag = common.synth_tag_for(args, splits)
+    # model_key folds the training hyperparameters into provenance;
+    # lr/num_steps_train are not in model_name_for's checkpoint key, so
+    # append them explicitly (ADVICE r4: two runs differing only in
+    # training config must not overwrite each other's artifact)
+    model_key = (f"{common.model_name_for(args, splits=splits)}"
+                 f"_steps{args.num_steps_train}_lr{args.lr:g}")
     art_path = artifact_path(
-        args.train_dir, args.model, args.dataset, args, test_indices, tag
+        args.train_dir, args.model, args.dataset, args, test_indices, tag,
+        model_key=model_key,
     )
     if os.path.basename(art_path) != f"RQ1-{args.model}-{args.dataset}.npz":
         print(f"existing artifact kept; rows -> {art_path}")
@@ -158,6 +202,7 @@ def main(argv=None):
                                  args.num_test, int(args.maxinf),
                                  args.seed], np.int64),
             stream_tag=np.asarray(tag),
+            model_key=np.asarray(model_key),
         )
 
     a = np.concatenate(actuals)

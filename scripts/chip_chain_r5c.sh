@@ -1,0 +1,29 @@
+#!/bin/bash
+# Round-5 chip chain, tier 13 (tail): MF Yelp full-protocol
+# wide-sample at the 2k x 2 wide-sample indices. Scheduled last
+# because Yelp full-protocol costs ~73 min/point (r3 measured, 7
+# chunks of 32 x 10.4 min at 24k steps): whatever fits before the
+# 08:30 deadline banks per point; the rest is the documented residue.
+set -u
+cd "$(dirname "$0")/.."
+CHAIN_TAG=chainR5c
+DEADLINE_EPOCH=$(date -d "2026-08-02 08:30:00 UTC" +%s)
+source "$(dirname "$0")/chain_lib.sh"
+
+until grep -q "^chainR5a: .* tier 12 done" output/chain.log; do
+  past_deadline && exit 0
+  sleep 120
+done
+
+echo "chainR5c: $(date) tier 13 starting" >> output/chain.log
+wait_tunnel
+
+run_watched "MF Yelp full-protocol n8 tail (24k x 4)" \
+  output/rq1_mf_yelp_full_n8.log \
+  python -m fia_tpu.cli.rq1 --dataset yelp --data_dir /root/reference/data \
+  --model MF --num_test 8 \
+  --test_indices 845 2095 3848 13799 15745 26143 32578 43506 \
+  --num_steps_train 15000 --num_steps_retrain 24000 --retrain_times 4 \
+  --num_to_remove 50 --batch_size 3009 --lane_chunk 32
+
+echo "chainR5c: $(date) tier 13 done" >> output/chain.log

@@ -77,6 +77,12 @@ def main():
                     help="one-hot matmul chunk")
     ap.add_argument("--out", default="output/gather_layout_ab.json")
     args = ap.parse_args()
+    if args.rows % args.chunk:
+        # the onehot variant sums (rows // chunk) * chunk terms; a
+        # non-multiple would silently sum fewer rows than the gather
+        # variants and skew the comparison (ADVICE r4)
+        ap.error(f"--rows ({args.rows}) must be a multiple of "
+                 f"--chunk ({args.chunk})")
 
     U, K, S = args.users, args.k, args.rows
     PACK = 128 // K  # rows per 128-lane tile row

@@ -1,0 +1,43 @@
+#!/bin/bash
+# Frees the machine before the driver's end-of-round bench (round 5).
+# The TPU is single-occupancy through the tunnel; a fidelity run still
+# holding it at round end would force BENCH_r05 onto the CPU fallback
+# (round 2's biggest miss). Session started 21:36 UTC Aug 1 + 12h =>
+# ends ~09:36 UTC Aug 2; fire at 08:30 for margin.
+#
+# Kill matching: argv0 must BE python (prefix match below); the
+# driver's argv0 is "claude" (its quoted prompt contains these
+# patterns — a bare pgrep -f killed a builder session in r4).
+set -u
+cd "$(dirname "$0")/.."
+
+exec 9> output/.endguard_r5.lock
+flock -n 9 || exit 0
+
+log() { echo "endguardR5: $(date) $*" >> output/chain.log; }
+
+DEADLINE_EPOCH=$(date -d "2026-08-02 08:30:00 UTC" +%s)
+now=$(date +%s)
+if [ "$DEADLINE_EPOCH" -gt "$now" ]; then
+  sleep $(( DEADLINE_EPOCH - now ))
+fi
+
+killed=0
+while read -r pid args; do
+  [ "$pid" = "$$" ] && continue
+  # bench.py deliberately NOT in the kill set: at the deadline it is
+  # either the driver's round-end bench or a short preview.
+  case "$args" in
+    python*fia_tpu.cli.rq1*|python*fia_tpu.cli.rq2*|\
+    python*ab_impls*|python*roofline*|python*scripts/stress*|\
+    python*limiter_sweep*)
+      kill "$pid" 2>/dev/null && killed=$((killed + 1))
+      ;;
+  esac
+done < <(ps -eo pid= -o args=)
+
+if [ "$killed" -gt 0 ]; then
+  log "deadline reached; freed the chip (killed $killed measurement jobs)"
+else
+  log "deadline reached; chip already free"
+fi

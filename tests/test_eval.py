@@ -174,14 +174,17 @@ class TestRQ1ArtifactPath:
         d.update(kw)
         return argparse.Namespace(**d)
 
-    def _bank(self, path, args, tag=""):
-        np.savez(path,
-                 protocol=np.asarray([args.num_steps_retrain,
-                                      args.retrain_times,
-                                      args.num_to_remove,
-                                      args.num_test, int(args.maxinf),
-                                      args.seed], np.int64),
-                 stream_tag=np.asarray(tag))
+    def _bank(self, path, args, tag="", model_key=None):
+        fields = dict(
+            protocol=np.asarray([args.num_steps_retrain,
+                                 args.retrain_times,
+                                 args.num_to_remove,
+                                 args.num_test, int(args.maxinf),
+                                 args.seed], np.int64),
+            stream_tag=np.asarray(tag))
+        if model_key is not None:
+            fields["model_key"] = np.asarray(model_key)
+        np.savez(path, **fields)
 
     def test_rules(self, tmp_path):
         from fia_tpu.cli.rq1 import artifact_path
@@ -192,17 +195,38 @@ class TestRQ1ArtifactPath:
         # empty dir: canonical
         assert artifact_path(td, "MF", "movielens", a, [1, 2], "cal2") \
             == canon
-        # same protocol + tag banked: overwrite in place (idempotent
-        # chain retry)
-        self._bank(canon, a, "cal2")
-        assert artifact_path(td, "MF", "movielens", a, [1, 2], "cal2") \
-            == canon
+        # same protocol + tag + model config banked: overwrite in
+        # place (idempotent chain retry)
+        self._bank(canon, a, "cal2", model_key="mf_cfg")
+        assert artifact_path(td, "MF", "movielens", a, [1, 2], "cal2",
+                             model_key="mf_cfg") == canon
+        # same protocol but different training config (model_key):
+        # divert, and the divert name carries a config digest so two
+        # diverted configs cannot clobber each other either
+        p = artifact_path(td, "MF", "movielens", a, [1, 2], "cal2",
+                          model_key="mf_cfg_steps9000")
+        assert p != canon
+        # canonical banked BEFORE model_key existed: treated as a
+        # different config (divert, never clobber)
+        legacy_canon = os.path.join(td, "RQ1-NCF-movielens.npz")
+        self._bank(legacy_canon, a, "cal2")
+        assert artifact_path(td, "NCF", "movielens", a, [1, 2], "cal2",
+                             model_key="ncf_cfg") != legacy_canon
         # different protocol: divert, name carries tag + protocol
         b = self._args(num_steps_retrain=18000, retrain_times=4,
                        num_to_remove=50, num_test=4)
         p = artifact_path(td, "MF", "movielens", b, [1, 2], "cal2")
         assert p == os.path.join(
             td, "RQ1-MF-movielens-cal2-r18000x4n4rm50.npz")
+        # an occupied divert path with a DIFFERENT model config gets a
+        # config-digest suffix instead of being overwritten; the same
+        # config re-run still lands on its own name (idempotent)
+        self._bank(p, b, "cal2", model_key="cfg_A")
+        p2 = artifact_path(td, "MF", "movielens", b, [1, 2], "cal2",
+                           model_key="cfg_B")
+        assert p2 != p and "-m" in os.path.basename(p2)
+        assert artifact_path(td, "MF", "movielens", b, [1, 2], "cal2",
+                             model_key="cfg_A") == p
         # different stream, same protocol: divert
         p = artifact_path(td, "MF", "movielens", a, [1, 2], "cal3")
         assert "cal3" in os.path.basename(p) and p != canon
@@ -216,8 +240,22 @@ class TestRQ1ArtifactPath:
         assert "seed3" in os.path.basename(p) and p != canon
         # explicit resume indices: pt-divert wins over protocol match
         c = self._args(test_indices=[5, 9])
+        pt = os.path.join(td, "RQ1-MF-movielens-pt5-9.npz")
         assert artifact_path(td, "MF", "movielens", c, [5, 9], "cal2") \
-            == os.path.join(td, "RQ1-MF-movielens-pt5-9.npz")
+            == pt
+        # an occupied -pt path from a DIFFERENT retrain protocol
+        # ladders to a protocol suffix instead of clobbering (r5:
+        # e.g. a 2k x R=32 noise-floor run vs an 18k x 4 resume at
+        # the same index)
+        self._bank(pt, c, "cal2", model_key="cfg_A")
+        c2 = self._args(test_indices=[5, 9], num_steps_retrain=18000,
+                        retrain_times=4)
+        p = artifact_path(td, "MF", "movielens", c2, [5, 9], "cal2",
+                          model_key="cfg_A")
+        assert p != pt and "pt5-9" in os.path.basename(p)
+        # identical resume re-run still lands on its own name
+        assert artifact_path(td, "MF", "movielens", c, [5, 9], "cal2",
+                             model_key="cfg_A") == pt
         # legacy artifact without provenance fields: treated as a
         # different run (divert, never clobber)
         legacy = os.path.join(td, "RQ1-NCF-yelp.npz")
@@ -249,8 +287,23 @@ class TestRQ1ArtifactPath:
         write(tmp_path / "a.npz", 1)
         write(tmp_path / "b.npz", 2)
         out = mod.merge([str(tmp_path / "a.npz"), str(tmp_path / "b.npz")])
-        assert tuple(out["protocol"]) == tuple(proto)
+        # num_test (protocol[3]) is recomputed as the merged point
+        # count; every other field must survive verbatim
+        assert tuple(out["protocol"]) == (2000, 2, 30, 2, 0, 0)
         assert str(out["stream_tag"]) == "cal2"
+        # a base run and its --test_indices resume differ ONLY in
+        # num_test — that mismatch must NOT drop provenance (the r4
+        # "? ? ?" summary-row gap)
+        proto2 = proto.copy()
+        proto2[3] = 4
+        np.savez(tmp_path / "b4.npz",
+                 actual_loss_diffs=np.ones(3),
+                 predicted_loss_diffs=np.ones(3),
+                 indices_to_remove=np.arange(3),
+                 test_index_of_row=np.full(3, 2),
+                 protocol=proto2, stream_tag=np.asarray("cal2"))
+        out = mod.merge([str(tmp_path / "a.npz"), str(tmp_path / "b4.npz")])
+        assert tuple(out["protocol"]) == (2000, 2, 30, 2, 0, 0)
         # disagreement (or a legacy input) drops provenance -> the
         # merged artifact downgrades to always-divert
         write(tmp_path / "c.npz", 3, with_prov=False)
