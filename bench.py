@@ -38,29 +38,66 @@ def _stage(msg: str) -> None:
     print(f"bench[{time.strftime('%H:%M:%S')}]: {msg}", file=sys.stderr, flush=True)
 
 
-def _pipelined(engine, points, batch_queries: int, seed: int) -> dict:
+def _pipelined(engine, points, batch_queries: int, seed: int,
+               seq_scores_per_sec: float | None = None) -> dict:
     """Steady-state streaming throughput via ``query_many`` (overlaps host
-    assembly with device compute across batches). Warmup uses each batch
-    row-permuted: identical per-batch query sets (so identical pad
-    buckets get compiled) but no timed dispatch ever repeats a warmup
-    batch's exact input buffer. One protocol for MF and NCF so the two
-    streaming numbers stay comparable."""
-    stream = np.concatenate([points, points[::-1]], axis=0)
+    assembly with device compute across batches).
+
+    r5 protocol fix (VERDICT r4 weak #1): the r2-r4 stream was only
+    2x the batch — TWO batches in flight, which is no pipeline at all,
+    and BENCH_r04's pipelined MF row duly lost to sequential while
+    every deeper-stream A/B (4+ batches) won by 16-44%. The stream is
+    now 4 batches and the window is SWEPT (1 = sequential dispatch
+    order, 2, 4) with the best window reported plus the whole sweep,
+    so the artifact itself shows whether overlap paid and by how much.
+
+    Warmup uses each batch row-permuted: identical per-batch query
+    sets (so identical pad buckets get compiled) but no timed dispatch
+    ever repeats a warmup batch's exact input buffer. One protocol for
+    MF and NCF so the two streaming numbers stay comparable."""
+    reps = max((4 * batch_queries) // len(points), 1)
+    stream = np.concatenate(
+        [points if r % 2 == 0 else points[::-1] for r in range(reps)],
+        axis=0,
+    )
     wrng = np.random.default_rng(seed)
     warm = np.concatenate([
         wrng.permutation(stream[i : i + batch_queries])
         for i in range(0, len(stream), batch_queries)
     ])
     engine.query_many(warm, batch_queries=batch_queries)
-    t0 = time.perf_counter()
-    res = engine.query_many(stream, batch_queries=batch_queries, window=4)
-    dt = time.perf_counter() - t0
-    n_scores = sum(int(r.counts.sum()) for r in res)
-    return {
-        "scores_per_sec": round(n_scores / dt, 1),
-        "queries_per_sec": round(len(stream) / dt, 2),
-        "batches": len(res),
+    sweep = {}
+    best_w, best_sps = None, -1.0
+    n_batches = -(-len(stream) // batch_queries)
+    for w in (1, 2, 4):
+        t0 = time.perf_counter()
+        res = engine.query_many(stream, batch_queries=batch_queries,
+                                window=w)
+        dt = time.perf_counter() - t0
+        n_scores = sum(int(r.counts.sum()) for r in res)
+        sps = n_scores / dt
+        sweep[f"window{w}_scores_per_sec"] = round(sps, 1)
+        if sps > best_sps:
+            best_w, best_sps, best_dt, best_scores = w, sps, dt, n_scores
+        if w >= n_batches:
+            break  # deeper windows cannot change the schedule
+    out = {
+        "scores_per_sec": round(best_sps, 1),
+        "queries_per_sec": round(len(stream) / best_dt, 2),
+        "batches": n_batches,
+        "window": best_w,
+        "window_sweep": sweep,
     }
+    if seq_scores_per_sec:
+        # occupancy diagnostic: estimated device time for the stream
+        # (from the sequential single-dispatch rate) over pipelined
+        # wall. ~1.0 means the device never starved; the window is
+        # working. >1 means the pipelined path beat the sequential
+        # estimate itself (pad buckets / batch-size effects).
+        out["overlap_occupancy"] = round(
+            (best_scores / seq_scores_per_sec) / best_dt, 3
+        )
+    return out
 
 
 def _ensure_live_backend(timeout_s: int = 90) -> None:
@@ -136,9 +173,19 @@ def main():
         rng = np.random.default_rng(17)
         sel = rng.choice(splits["test"].num_examples, n_queries, replace=False)
         points = splits["test"].x[sel]
+        # extra disjoint queries for the 1,024-dispatch headline row
+        # (VERDICT r4 next #7; the 256 cross-round points stay the
+        # prefix so the two rows share an agreement sample). Drawn
+        # AFTER sel from the same rng: sel and points are unchanged.
+        rest = np.setdiff1d(np.arange(splits["test"].num_examples), sel)
+        points_big = np.concatenate(
+            [points, splits["test"].x[rng.choice(rest, 1024 - n_queries,
+                                                 replace=False)]]
+        )
     else:
         train = synthesize_ratings(users, items, rows, seed=0)
         stream = "zipf"
+        points_big = None
     model = MF(users, items, k, wd)
     params = model.init_params(jax.random.PRNGKey(0))
 
@@ -234,10 +281,49 @@ def main():
     # pipelined steady-state: the headline metric stays the sequential
     # path for cross-round comparability, this is the streaming-workload
     # number (protocol in _pipelined)
-    pipelined = _pipelined(engine, points, n_queries, seed=23)
+    pipelined = _pipelined(engine, points, n_queries, seed=23,
+                           seq_scores_per_sec=timing.scores_per_sec)
     log.log("query_many", model="MF", **pipelined)
-    _stage(f"pipelined: {pipelined['scores_per_sec']:.0f} scores/s; "
-           f"running CPU reference on {n_base} queries")
+    _stage(f"pipelined: {pipelined['scores_per_sec']:.0f} scores/s "
+           f"(window {pipelined.get('window')})")
+
+    # the n_base-query result is the agreement anchor for both the
+    # 1024-dispatch row and the CPU-reference parity loop below
+    res = engine.query_batch(points[:n_base])
+
+    # --- 1,024-query single-dispatch row (VERDICT r4 next #7) -----------
+    # The dispatch-size ladder measured its optimum at 1,024 queries
+    # (2.98M scores/s, output/ab_impls_mf_1024q.json); the official
+    # artifact now carries that row next to the 256-query cross-round
+    # protocol row, with a rank-agreement check between the two
+    # dispatch widths.
+    batch1024 = {}
+    if points_big is not None:
+        try:
+            _stage("timing 1024-query single-dispatch row")
+            t1024 = time_influence_queries(engine, points_big, repeats=3)
+            res_big = engine.query_batch(points_big)
+            agree = [
+                spearman(res_big.scores_of(t), res.scores_of(t))
+                for t in range(n_base)
+            ]
+            batch1024 = {
+                "scores_per_sec": round(t1024.scores_per_sec, 1),
+                "queries_per_sec": round(t1024.queries_per_sec, 2),
+                "per_query_ms": round(t1024.per_query_ms, 3),
+                "num_queries": t1024.num_queries,
+                "num_scores": t1024.num_scores,
+                "agreement_spearman_min_vs_small_dispatch": round(
+                    float(min(agree)), 4
+                ),
+            }
+            log.log("query_batch_1024", model="MF", **batch1024)
+            _stage(f"1024-query dispatch: "
+                   f"{t1024.scores_per_sec:.0f} scores/s")
+        except Exception as e:  # noqa: BLE001 — keep the headline rows
+            _stage(f"1024-query stage FAILED: {e!r}")
+            batch1024 = {"error": repr(e)}
+    _stage(f"running CPU reference on {n_base} queries")
 
     # --- CPU baseline (reference-architecture engine) on a sample -------
     # Timing uses the reference's own solver settings (avextol 1e-3,
@@ -258,7 +344,6 @@ def main():
     base_scores_total = 0
     base_time = 0.0
     rhos = []
-    res = engine.query_batch(points[:n_base])
     for t in range(n_base):
         u, i = int(points[t, 0]), int(points[t, 1])
         per_rep = []
@@ -271,7 +356,39 @@ def main():
         rhos.append(spearman(res.scores_of(t), ref_tight.query(u, i)[0]))
 
     base_scores_per_sec = base_scores_total / base_time
-    vs_baseline = timing.scores_per_sec / base_scores_per_sec
+    vs_baseline_live = timing.scores_per_sec / base_scores_per_sec
+    # Pinned denominator (VERDICT r4 weak #5): scripts/pin_baseline.py
+    # measures the torch reference once under a pinned protocol and
+    # persists it; the headline ratio uses that stable number, the
+    # live in-run sample rides along for drift detection. Falls back
+    # to live-only when the pinned artifact is absent (quick mode, or
+    # a fresh checkout before the pin run).
+    pinned = None
+    try:
+        with open(os.path.join("output", "pinned_baseline.json")) as fh:
+            pinned = json.load(fh)
+    except (OSError, ValueError):
+        pass
+    vs_baseline = vs_baseline_live
+    pinned_summary = None
+    if pinned and not QUICK:
+        try:
+            pinned_sps = float(pinned["mf"]["scores_per_sec"])
+            pinned_summary = {
+                "scores_per_sec": pinned_sps,
+                "measured_at": pinned["provenance"]["measured_at"],
+                "queries": pinned["mf"]["queries"],
+                "live_vs_pinned_drift": round(
+                    base_scores_per_sec / pinned_sps, 3
+                ),
+            }
+            vs_baseline = timing.scores_per_sec / pinned_sps
+        except (KeyError, TypeError, ValueError) as e:
+            # malformed pinned artifact must not cost the completed
+            # measurements — fall back to the live denominator
+            _stage(f"pinned baseline unusable ({e!r}); using live")
+            pinned_summary = {"error": repr(e)}
+            vs_baseline = vs_baseline_live
 
     # --- NCF stage (BASELINE.json configs 3/4): timing + parity ---------
     # Failure here (OOM, tunnel drop) must not discard the completed MF
@@ -308,7 +425,8 @@ def main():
         try:
             # NCF streaming number, same protocol as the MF pipelined stage
             ncf_out["pipelined"] = _pipelined(
-                ncf_engine, points[:ncf_q], ncf_q, seed=29
+                ncf_engine, points[:ncf_q], ncf_q, seed=29,
+                seq_scores_per_sec=ncf_timing.scores_per_sec,
             )
             log.log("query_many", model="NCF", **ncf_out["pipelined"])
         except Exception as e:  # noqa: BLE001
@@ -355,6 +473,9 @@ def main():
             "num_scores": timing.num_scores,
             "cpu_ref_scores_per_sec": round(base_scores_per_sec, 1),
             "cpu_ref_best_of": base_reps,
+            "cpu_ref_pinned": pinned_summary,
+            "vs_baseline_live": round(vs_baseline_live, 2),
+            "batch1024": batch1024,
             "spearman_vs_cpu_ref_min": round(float(min(rhos)), 4),
             "spearman_vs_cpu_ref_median": round(float(np.median(rhos)), 4),
             "parity_queries": n_base,

@@ -1,0 +1,39 @@
+#!/bin/bash
+# Round-5 chip chain, perf shorts. Runs in the window chip_chain_r5a
+# opens after its MF ML-1M full-protocol tier ("mfml full n8 done"
+# marker); r5a waits for this chain's "perf shorts done" marker (cap
+# 90 min) before resuming with the cal3 matrix.
+#
+#  1. bench.py full preview — validates the r5 bench changes on the
+#     chip (auto-window pipelined protocol with 4-batch depth,
+#     1,024-query dispatch row + cross-width agreement, pinned
+#     denominator) BEFORE the driver's round-end BENCH_r05 run.
+#  2. limiter_sweep — measured-scaling identification of the 36-40 ms
+#     device program's binding resource (VERDICT r4 next #4).
+#  3. roofline --trace — the jax.profiler-through-the-tunnel attempt
+#     VERDICT asked for; outcome (trace or failure) recorded either way.
+set -u
+cd "$(dirname "$0")/.."
+CHAIN_TAG=chainR5b
+DEADLINE_EPOCH=$(date -d "2026-08-02 08:30:00 UTC" +%s)
+source "$(dirname "$0")/chain_lib.sh"
+
+until grep -q "^chainR5a: .* mfml full n8 done" output/chain.log; do
+  past_deadline && exit 0
+  sleep 60
+done
+
+echo "chainR5b: $(date) perf shorts starting" >> output/chain.log
+wait_tunnel
+
+run_watched "bench r5 preview" output/bench_r5_preview.log \
+  python bench.py --json_out output/bench_r5_preview.json
+
+run_watched "limiter sweep" output/limiter_sweep.log \
+  python scripts/limiter_sweep.py --rounds 5
+
+run_watched "roofline profiler trace attempt" output/roofline_trace_r5.log \
+  python scripts/roofline.py --rounds 3 --trace output/trace_r5
+
+# marker emitted even if jobs failed: r5a must not stall on us
+echo "chainR5b: $(date) perf shorts done" >> output/chain.log

@@ -1,0 +1,257 @@
+#!/usr/bin/env python
+"""Identify the binding resource of the post-ladder flat device program.
+
+VERDICT r4 weak #2: the r4 roofline's utilization claims rested on
+XLA's billed-bytes cost model, and the gather-layout A/B then showed
+billed bytes swinging 1.5->19 GB across layouts that time IDENTICALLY
+— the cost model does not track the hardware, so "73% of HBM BW /
+85% of the HBM roofline" was withdrawn and the 36-40 ms program's true
+limiter was left unnamed.
+
+This script names it from MEASURED scaling only. Three controlled
+sweeps of the SAME compiled flat program (fia_tpu/influence/engine.py
+`_flat_fn`, stage='scores' = the full per-query pipeline
+gather -> block grads -> Hessian -> solve -> scores):
+
+  T sweep    query count {32..256} at ONE fixed s_pad -> isolates
+             per-query work (Hessian assembly, d-dim solves, output).
+  pad sweep  s_pad {64k..512k} at T=64 -> isolates per-padded-row work
+             (the gather + per-row block grads + scoring stream).
+  k sweep    embed size {8..64} at T=256, natural pad -> how the
+             per-row and per-query terms scale with block size
+             d = 2k+2 (MF).
+
+Each point: interleaved rounds over disjoint query batches, one-scalar
+completion probe (the tunnel's block_until_ready can return early),
+null-program dispatch floor measured in the same rounds and
+subtracted. The fit t(T, pad) = a + b*pad + c*T at k=16 plus the
+k-scaling of b and c names the limiter in ns/row and ns/query terms;
+bytes-per-row implied by b at the (8,128)-tile size then gives a
+hardware-grounded bandwidth figure to replace the billed-bytes one.
+
+Usage: python scripts/limiter_sweep.py [--rounds 5] [--quick]
+       [--out output/limiter_sweep.json]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--rounds", type=int, default=5)
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--train_steps", type=int, default=3000)
+    ap.add_argument("--data_dir", default="/root/reference/data")
+    ap.add_argument("--out", default=os.path.join(
+        "output", "limiter_sweep.json"))
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from fia_tpu.data.index import bucketed_pad
+    from fia_tpu.influence.engine import InfluenceEngine
+    from fia_tpu.models import MF
+    from fia_tpu.train.trainer import Trainer, TrainConfig
+
+    if not args.quick and os.path.isdir(args.data_dir):
+        from fia_tpu.data.loaders import load_dataset
+
+        splits = load_dataset("movielens", args.data_dir)
+        train, test_x = splits["train"], splits["test"].x
+        users, items = 6_040, 3_706
+        T_SWEEP = (32, 64, 128, 256)
+        PAD_SWEEP = (65_536, 131_072, 262_144, 524_288)
+        K_SWEEP = (8, 16, 32, 64)
+        PAD_T = 64
+    else:
+        from fia_tpu.data.synthetic import (
+            sample_heldout_pairs,
+            synthesize_ratings,
+        )
+
+        users, items = 600, 400
+        train = synthesize_ratings(users, items, 50_000, seed=0)
+        test_x = sample_heldout_pairs(train.x, users, items, 1024, seed=17)
+        T_SWEEP = (8, 16, 32)
+        PAD_SWEEP = (4_096, 8_192, 16_384)
+        K_SWEEP = (8, 16)
+        PAD_T = 8
+
+    backend = jax.default_backend()
+    log = lambda m: print(f"limiter[{time.strftime('%H:%M:%S')}]: {m}",
+                          file=sys.stderr, flush=True)
+    log(f"backend={backend} train={train.num_examples}")
+
+    rng = np.random.default_rng(17)
+    order = rng.permutation(len(test_x))
+
+    def batches_of(T):
+        n = min(args.rounds, max(1, len(test_x) // T))
+        return [test_x[order[r * T: (r + 1) * T]] for r in range(n)]
+
+    def build(k):
+        model = MF(users, items, k, 1e-3)
+        tr = Trainer(model, TrainConfig(batch_size=3020,
+                                        num_steps=args.train_steps,
+                                        learning_rate=1e-3))
+        params = tr.fit(
+            tr.init_state(model.init_params(jax.random.PRNGKey(0))),
+            train.x, train.y,
+        ).params
+        return model, InfluenceEngine(model, params, train, damping=1e-6,
+                                      solver="direct", pad_bucket=512,
+                                      impl="flat")
+
+    null_fn = jax.jit(lambda params, tx: jnp.sum(tx))
+
+    def prep_config(eng, T, s_pad, **extra):
+        """Compile + warm one (engine, T, s_pad) cell."""
+        txs = [jnp.asarray(b, jnp.int32) for b in batches_of(T)]
+        fn = eng._flat_fn(s_pad, stage="scores")
+        a0 = (eng.params, eng.train_x, eng.train_y, eng._postings,
+              txs[0], eng._rowfeat)
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*a0))
+        compile_s = time.perf_counter() - t0
+        log(f"compiled T={T} pad={s_pad} ({compile_s:.0f}s)")
+        return {"eng": eng, "fn": fn, "txs": txs, "T": T,
+                "s_pad": s_pad, "compile_s": compile_s, **extra}
+
+    def run_sweep(configs, tag):
+        """Interleave rounds ACROSS the sweep's configs (the tunnel's
+        chip-state drift would otherwise bias consecutive per-config
+        minima and with them the fitted slopes), best-of-rounds each,
+        one shared null floor per round."""
+        best = [float("inf")] * len(configs)
+        null_best = float("inf")
+        # warm the null program for this sweep's probe shape: its
+        # round-0 sample would otherwise include a fresh (T, 2)-shape
+        # compile and could exceed the programs being measured,
+        # driving every null-subtracted device_ms negative
+        float(null_fn(configs[0]["eng"].params, configs[0]["txs"][0]))
+        for r in range(args.rounds):
+            c0 = configs[0]
+            tx0 = c0["txs"][r % len(c0["txs"])]
+            t0 = time.perf_counter()
+            float(null_fn(c0["eng"].params, tx0))
+            null_best = min(null_best, time.perf_counter() - t0)
+            for ci, c in enumerate(configs):
+                eng = c["eng"]
+                tx = c["txs"][r % len(c["txs"])]
+                a = (eng.params, eng.train_x, eng.train_y,
+                     eng._postings, tx, eng._rowfeat)
+                t0 = time.perf_counter()
+                out = c["fn"](*a)
+                jax.block_until_ready(out)
+                leaf = jax.tree_util.tree_leaves(out)[0]
+                float(jnp.reshape(leaf, (-1,))[0])
+                best[ci] = min(best[ci], time.perf_counter() - t0)
+        rows = []
+        for c, b in zip(configs, best):
+            dev_ms = (b - null_best) * 1e3
+            row = {"T": c["T"], "s_pad": c["s_pad"],
+                   "device_ms": round(dev_ms, 2),
+                   "wall_ms": round(b * 1e3, 2),
+                   "null_ms": round(null_best * 1e3, 2),
+                   "compile_s": round(c["compile_s"], 1)}
+            for k in ("k", "d"):
+                if k in c:
+                    row[k] = c[k]
+            log(f"{tag}: T={row['T']} pad={row['s_pad']} "
+                + (f"k={row.get('k')} " if "k" in row else "")
+                + f"-> {dev_ms:.1f} ms device (wall {row['wall_ms']}, "
+                  f"null {row['null_ms']})")
+            rows.append(row)
+        return rows
+
+    out = {"backend": backend, "rounds": args.rounds,
+           "train_steps": args.train_steps,
+           "t_sweep": [], "pad_sweep": [], "k_sweep": []}
+
+    model16, eng16 = build(16)
+    log("k=16 engine ready")
+
+    # shared pad for the T sweep: the largest batch's natural bucket,
+    # so every T gathers the same padded row count
+    big = batches_of(max(T_SWEEP))[0]
+    pad_shared = bucketed_pad(int(eng16.index.counts_batch(big).sum()),
+                              2048)
+    out["t_sweep"] = run_sweep(
+        [prep_config(eng16, T, pad_shared) for T in T_SWEEP], "Tsweep"
+    )
+    out["pad_sweep"] = run_sweep(
+        [prep_config(eng16, PAD_T, pad) for pad in PAD_SWEEP], "padsweep"
+    )
+
+    k_configs = []
+    T = max(T_SWEEP)
+    b = batches_of(T)[0]
+    for k in K_SWEEP:
+        if k == 16:
+            model, eng = model16, eng16
+        else:
+            model, eng = build(k)
+            log(f"k={k} engine ready")
+        s_pad = bucketed_pad(int(eng.index.counts_batch(b).sum()), 2048)
+        k_configs.append(prep_config(eng, T, s_pad, k=k,
+                                     d=model.block_size))
+    out["k_sweep"] = run_sweep(k_configs, "ksweep")
+
+    # ---- fits (plain least squares on the measured points) -----------
+    def fit_line(xs, ys):
+        A = np.vstack([np.ones(len(xs)), xs]).T
+        (a, b), res, *_ = np.linalg.lstsq(A, ys, rcond=None)
+        ss = np.sum((ys - np.mean(ys)) ** 2)
+        r2 = 1.0 - (float(res[0]) / ss if len(res) and ss > 0 else 0.0)
+        return float(a), float(b), float(r2)
+
+    pads = np.array([r["s_pad"] for r in out["pad_sweep"]], float)
+    pms = np.array([r["device_ms"] for r in out["pad_sweep"]], float)
+    a_p, b_p, r2_p = fit_line(pads, pms)
+    Ts = np.array([r["T"] for r in out["t_sweep"]], float)
+    tms = np.array([r["device_ms"] for r in out["t_sweep"]], float)
+    a_t, b_t, r2_t = fit_line(Ts, tms)
+    ns_per_row = b_p * 1e6  # ms/row -> ns/row
+    out["fit"] = {
+        "pad_slope_ns_per_row": round(ns_per_row, 2),
+        "pad_intercept_ms": round(a_p, 2),
+        "pad_r2": round(r2_p, 4),
+        "per_query_slope_ms": round(b_t, 4),
+        "t_intercept_ms": round(a_t, 2),
+        "t_r2": round(r2_t, 4),
+        # one (8,128) f32 tile per random row read = 4 KB; the
+        # gather's minimum real traffic at k=16 is one tile row
+        # (128 lanes * 4 B = 512 B) if sublane-addressable, the full
+        # tile (4 KB) if not. Implied bandwidth at the measured slope:
+        "implied_GBps_at_512B_per_row": round(
+            512 / (ns_per_row * 1e-9) / 1e9, 1) if ns_per_row > 0 else None,
+        "implied_GBps_at_4KB_per_row": round(
+            4096 / (ns_per_row * 1e-9) / 1e9, 1) if ns_per_row > 0 else None,
+    }
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out + ".tmp", "w") as fh:
+        json.dump(out, fh, indent=1)
+    os.replace(args.out + ".tmp", args.out)
+    log(f"wrote {args.out}")
+    print(json.dumps(out["fit"]))
+
+
+if __name__ == "__main__":
+    main()

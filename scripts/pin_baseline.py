@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""Measure the torch-CPU reference baseline ONCE, under a pinned
+protocol, and persist it with provenance (VERDICT r4 weak #5 / next
+#6: the bench's live denominator moved +-35-40% between runs of
+identical code on this single-core host, dragging the headline
+vs_baseline with it).
+
+Protocol (recorded in the artifact):
+  - model/params: the committed ML-1M cal2 MF checkpoint (the same
+    config bench.py trains: k=16, wd 1e-3, 15k steps, cal2 stream) —
+    reference solver settings avextol 1e-3 / maxiter 100
+    (/root/reference/src/scripts/RQ1.py:19-20, its real speed).
+  - queries: the first 64 of bench.py's own seed-17 test-split
+    selection, so the pinned and live denominators sample the same
+    workload distribution.
+  - timing: best-of-5 wall per query (the host has ONE core; ambient
+    load inflates single samples), summed over queries; per-query
+    bests are stored so later rounds can re-validate the distribution
+    instead of re-measuring.
+  - torch threads pinned to 1 (explicit even though nproc=1, so the
+    artifact stays valid if a future image adds cores).
+
+bench.py reads output/pinned_baseline.json and reports vs_baseline
+against the pinned number (stable across chip/tunnel state), plus
+vs_baseline_live from its in-run sample for drift detection.
+
+Usage: python scripts/pin_baseline.py [--queries 64] [--reps 5]
+       [--out output/pinned_baseline.json]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--queries", type=int, default=64)
+    ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--data_dir", default="/root/reference/data")
+    ap.add_argument("--checkpoint", default=os.path.join(
+        "output", "movielens_MF_explicit_damping1e-06_avextol1e-03_"
+        "embed16_maxinf1_wd1e-03_cal2-checkpoint-14999.npz"))
+    ap.add_argument("--out", default=os.path.join(
+        "output", "pinned_baseline.json"))
+    args = ap.parse_args()
+
+    import torch
+
+    torch.set_num_threads(1)
+    # jax is only used to unflatten the checkpoint pytree; keep it off
+    # the (single-occupancy) TPU. The image's sitecustomize forces
+    # platform=axon, so re-apply after import too.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from fia_tpu.backends.torch_ref import TorchRefMFEngine
+    from fia_tpu.data.loaders import load_dataset
+    from fia_tpu.models import MF
+    from fia_tpu.train import checkpoint
+
+    splits = load_dataset("movielens", args.data_dir)
+    train = splits["train"]
+    model = MF(6040, 3706, 16, 1e-3)
+    template = model.init_params(jax.random.PRNGKey(0))
+    params, _, _ = checkpoint.load(args.checkpoint, template)
+    params = {k: np.asarray(v) for k, v in params.items()}
+
+    # bench.py's exact query selection (seed 17 over the test split)
+    rng = np.random.default_rng(17)
+    sel = rng.choice(splits["test"].num_examples, 256, replace=False)
+    points = splits["test"].x[sel][: args.queries]
+
+    wd, damping = 1e-3, 1e-6
+    ref = TorchRefMFEngine(params, train.x, train.y, weight_decay=wd,
+                           damping=damping)
+
+    load_before = os.getloadavg()
+    t_start = time.time()
+    per_query = []
+    total_scores = 0
+    for t, (u, i) in enumerate(points):
+        reps = []
+        for _ in range(args.reps):
+            t0 = time.perf_counter()
+            scores, rows = ref.query(int(u), int(i))
+            reps.append(time.perf_counter() - t0)
+        per_query.append({"u": int(u), "i": int(i), "rows": len(rows),
+                          "best_s": round(min(reps), 5),
+                          "all_s": [round(r, 5) for r in reps]})
+        total_scores += len(rows)
+        if (t + 1) % 8 == 0:
+            print(f"[{time.strftime('%H:%M:%S')}] {t + 1}/{len(points)} "
+                  "queries", file=sys.stderr, flush=True)
+
+    total_time = sum(q["best_s"] for q in per_query)
+    out = {
+        "mf": {
+            "scores_per_sec": round(total_scores / total_time, 1),
+            "queries": len(points),
+            "scores": total_scores,
+            "best_of": args.reps,
+            "median_query_s": round(
+                float(np.median([q["best_s"] for q in per_query])), 5),
+            "per_query": per_query,
+        },
+        "provenance": {
+            "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                         time.gmtime()),
+            "wall_s": round(time.time() - t_start, 1),
+            "torch_version": torch.__version__,
+            "torch_threads": 1,
+            "cpu_count": os.cpu_count(),
+            "loadavg_before": load_before,
+            "loadavg_after": os.getloadavg(),
+            "checkpoint": os.path.basename(args.checkpoint),
+            "stream": getattr(train, "synth_tag", "") or "real",
+            "solver": "fmin_ncg avextol 1e-3 maxiter 100",
+            "query_selection": "seed-17 test-split sample, first "
+                               f"{len(points)} of bench.py's 256",
+        },
+    }
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    tmp = args.out + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(out, fh, indent=1)
+    os.replace(tmp, args.out)
+    print(json.dumps({"scores_per_sec": out["mf"]["scores_per_sec"],
+                      "queries": len(points),
+                      "loadavg": load_before}))
+
+
+if __name__ == "__main__":
+    main()
