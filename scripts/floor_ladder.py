@@ -1,0 +1,161 @@
+#!/usr/bin/env python
+"""Decompose the RQ1 noise floor into retraining noise vs estimator
+error by repeat-subsampling (VERDICT r4 weak #4 / next #3).
+
+Model: for one test point with removals i and retrain repeats j, the
+stored per-repeat predictions give paired actuals
+a_i(S) = mean_{j in S}(y_ij - d_j) (CRN pairing against the drift lane
+d_j is built into eval/rq1.py: every lane of repeat j shares seed j,
+and the mean-difference estimator IS the paired estimator). The
+residual around the slope fit a ~ b*p then follows
+
+    resid^2(r) = floor_inf^2 + sigma^2 / r        (r = |S|)
+
+where sigma is the per-repeat retraining-stochasticity scale (it
+averages out: 1/sqrt(r)) and floor_inf is the REPEAT-INDEPENDENT error
+(linearization + protocol bias — the estimator's true error). Fitting
+(A, B) = (floor_inf^2, sigma^2) over the subset-size ladder answers
+the judge's question directly: if A ~ 0 the 0.71-0.94 per-point spread
+is harness noise and the converged correlation r_inf (computed from
+the signal variance and A alone) approaches 1; if A > 0 that is the
+real estimator error at this point.
+
+Works on any artifact with repeat_y/drift_repeat_y/y0_of_point (r4+):
+R=4 full-protocol artifacts give the ladder r in {1, 2, 4}; the r5
+R=32 runs (chip_chain_r5a T3) extend it to {1, 2, 4, 8, 16, 32}.
+
+Usage: python scripts/floor_ladder.py output/RQ1-NCF-*.npz
+       [--out output/floor_ladder.json]
+"""
+
+import argparse
+import glob
+import itertools
+import json
+import os
+import sys
+
+import numpy as np
+
+
+def subsets_of_size(R, r, max_draws=20, seed=0):
+    """Distinct repeat-index subsets of size r (all of them if few,
+    else max_draws random ones, deterministic)."""
+    from math import comb
+
+    if comb(R, r) <= max_draws:
+        return [np.asarray(s) for s in itertools.combinations(range(R), r)]
+    rng = np.random.default_rng(seed)
+    seen, out = set(), []
+    while len(out) < max_draws:
+        s = tuple(sorted(rng.choice(R, r, replace=False).tolist()))
+        if s not in seen:
+            seen.add(s)
+            out.append(np.asarray(s))
+    return out
+
+
+def point_ladder(y_rows, d_reps, pred, sizes, max_draws=20):
+    """Mean squared slope-fit residual at each subset size.
+
+    y_rows: (n, R) per-repeat post-retrain predictions per removal;
+    d_reps: (R,) drift-lane predictions; pred: (n,) influence
+    predictions. Returns {r: mean resid^2 over subsets}."""
+    n, R = y_rows.shape
+    out = {}
+    for r in sizes:
+        sq = []
+        for S in subsets_of_size(R, r, max_draws):
+            a = (y_rows[:, S] - d_reps[None, S]).mean(axis=1)
+            # slope fit through the origin-per-point convention the
+            # spread analysis uses (a ~ b * p): residual around the
+            # best linear map of predictions onto actuals
+            A = np.vstack([np.ones(n), pred]).T
+            coef, *_ = np.linalg.lstsq(A, a, rcond=None)
+            resid = a - A @ coef
+            sq.append(float(np.mean(resid ** 2)))
+        out[r] = float(np.mean(sq))
+    return out
+
+
+def fit_floor(ladder):
+    """Least-squares (A, B) for resid2 = A + B / r; A clipped at 0."""
+    rs = np.asarray(sorted(ladder), float)
+    ys = np.asarray([ladder[int(r)] for r in rs])
+    M = np.vstack([np.ones(len(rs)), 1.0 / rs]).T
+    (A, B), *_ = np.linalg.lstsq(M, ys, rcond=None)
+    ss = float(np.sum((ys - ys.mean()) ** 2))
+    pred = M @ np.array([A, B])
+    r2 = 1.0 - float(np.sum((ys - pred) ** 2)) / ss if ss > 0 else 1.0
+    return max(float(A), 0.0), float(B), r2
+
+
+def analyze(path, max_draws=20):
+    d = np.load(path)
+    need = {"repeat_y", "drift_repeat_y", "y0_of_point",
+            "predicted_loss_diffs", "test_index_of_row"}
+    if not need <= set(d.files):
+        return {"file": os.path.basename(path),
+                "skipped": "no per-repeat fields (pre-r4 artifact)"}
+    g = d["test_index_of_row"]
+    uniq = list(dict.fromkeys(int(t) for t in g))
+    R = d["repeat_y"].shape[1]
+    sizes = [s for s in (1, 2, 4, 8, 16, 32) if s <= R]
+    rows = []
+    for pi, t in enumerate(uniq):
+        m = g == t
+        y_rows = np.asarray(d["repeat_y"][m], np.float64)
+        d_reps = np.asarray(d["drift_repeat_y"][pi], np.float64)
+        pred = np.asarray(d["predicted_loss_diffs"][m], np.float64)
+        a_full = (y_rows - d_reps[None, :]).mean(axis=1)
+        ladder = point_ladder(y_rows, d_reps, pred, sizes, max_draws)
+        A, B, fit_r2 = fit_floor(ladder)
+        var_sig = float(np.var(a_full))
+        # converged correlation if only the 1/r component averaged out:
+        # r^2 = var_signal / (var_signal + A). var_signal from the
+        # full-repeat actuals (slightly noise-inflated: subtract the
+        # remaining B/R residual component, clipped at 10% of itself)
+        var_sig_clean = max(var_sig - B / R, 0.1 * var_sig)
+        r_now = float(np.corrcoef(a_full, pred)[0, 1])
+        r_inf = float(np.sqrt(var_sig_clean / (var_sig_clean + A)))
+        rows.append({
+            "point": t, "rows": int(m.sum()), "repeats": R,
+            "ladder_resid2": {str(k): v for k, v in ladder.items()},
+            "floor_inf": round(float(np.sqrt(A)), 6),
+            "sigma_per_repeat": round(float(np.sqrt(max(B, 0.0))), 6),
+            "fit_r2": round(fit_r2, 4),
+            "pearson_now": round(r_now, 4),
+            "pearson_converged_est": round(r_inf, 4),
+            "noise_dominated": bool(B / R > A),
+        })
+    return {"file": os.path.basename(path), "repeats": R,
+            "points": rows}
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("artifacts", nargs="*", default=None)
+    ap.add_argument("--max_draws", type=int, default=20)
+    ap.add_argument("--out", default=os.path.join(
+        "output", "floor_ladder.json"))
+    args = ap.parse_args()
+    paths = args.artifacts or sorted(
+        glob.glob(os.path.join("output", "RQ1-*.npz")))
+    results = [analyze(p, args.max_draws) for p in paths]
+    with open(args.out + ".tmp", "w") as fh:
+        json.dump(results, fh, indent=1)
+    os.replace(args.out + ".tmp", args.out)
+    for res in results:
+        if "skipped" in res:
+            continue
+        print(f"== {res['file']} (R={res['repeats']})")
+        for r in res["points"]:
+            print(f"  pt {r['point']}: r={r['pearson_now']:.3f} -> "
+                  f"r_inf~{r['pearson_converged_est']:.3f} "
+                  f"(floor_inf {r['floor_inf']:.2e}, sigma/rep "
+                  f"{r['sigma_per_repeat']:.2e}, fit R2 {r['fit_r2']})")
+    print(f"wrote {args.out}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
