@@ -60,21 +60,30 @@ def point_ladder(y_rows, d_reps, pred, sizes, max_draws=20):
 
     y_rows: (n, R) per-repeat post-retrain predictions per removal;
     d_reps: (R,) drift-lane predictions; pred: (n,) influence
-    predictions. Returns {r: mean resid^2 over subsets}."""
-    n, R = y_rows.shape
+    predictions. Returns {r: mean resid^2 over subsets}.
+
+    NaN repeats (the harness drops NaN retrain outcomes with nanmean,
+    eval/rq1.py) are averaged around per pair; rows whose whole subset
+    is NaN are excluded from that subset's fit."""
     out = {}
+    diffs = y_rows - d_reps[None, :]  # (n, R) paired per-repeat actuals
     for r in sizes:
         sq = []
-        for S in subsets_of_size(R, r, max_draws):
-            a = (y_rows[:, S] - d_reps[None, S]).mean(axis=1)
-            # slope fit through the origin-per-point convention the
-            # spread analysis uses (a ~ b * p): residual around the
-            # best linear map of predictions onto actuals
-            A = np.vstack([np.ones(n), pred]).T
-            coef, *_ = np.linalg.lstsq(A, a, rcond=None)
-            resid = a - A @ coef
+        for S in subsets_of_size(y_rows.shape[1], r, max_draws):
+            with np.errstate(invalid="ignore"):
+                a = np.nanmean(diffs[:, S], axis=1)
+            valid = np.isfinite(a) & np.isfinite(pred)
+            if valid.sum() < 5:
+                continue
+            av, pv = a[valid], pred[valid]
+            # residual around the best linear map of predictions onto
+            # actuals (the spread analysis' slope-fit convention)
+            M = np.vstack([np.ones(valid.sum()), pv]).T
+            coef, *_ = np.linalg.lstsq(M, av, rcond=None)
+            resid = av - M @ coef
             sq.append(float(np.mean(resid ** 2)))
-        out[r] = float(np.mean(sq))
+        if sq:
+            out[r] = float(np.mean(sq))
     return out
 
 
@@ -99,6 +108,12 @@ def analyze(path, max_draws=20):
                 "skipped": "no per-repeat fields (pre-r4 artifact)"}
     g = d["test_index_of_row"]
     uniq = list(dict.fromkeys(int(t) for t in g))
+    if len(uniq) != len(d["drift_repeat_y"]):
+        # positional alignment of the per-point arrays would pair
+        # wrong drift lanes (same guard as scripts/merge_rq1.py)
+        return {"file": os.path.basename(path),
+                "skipped": f"{len(d['drift_repeat_y'])} per-point rows "
+                           f"vs {len(uniq)} distinct test points"}
     R = d["repeat_y"].shape[1]
     sizes = [s for s in (1, 2, 4, 8, 16, 32) if s <= R]
     rows = []
@@ -107,17 +122,23 @@ def analyze(path, max_draws=20):
         y_rows = np.asarray(d["repeat_y"][m], np.float64)
         d_reps = np.asarray(d["drift_repeat_y"][pi], np.float64)
         pred = np.asarray(d["predicted_loss_diffs"][m], np.float64)
-        a_full = (y_rows - d_reps[None, :]).mean(axis=1)
+        with np.errstate(invalid="ignore"):
+            a_full = np.nanmean(y_rows - d_reps[None, :], axis=1)
+        vmask = np.isfinite(a_full) & np.isfinite(pred)
+        a_full, pred_v = a_full[vmask], pred[vmask]
         ladder = point_ladder(y_rows, d_reps, pred, sizes, max_draws)
+        if len(ladder) < 2:
+            continue
         A, B, fit_r2 = fit_floor(ladder)
-        var_sig = float(np.var(a_full))
-        # converged correlation if only the 1/r component averaged out:
-        # r^2 = var_signal / (var_signal + A). var_signal from the
-        # full-repeat actuals (slightly noise-inflated: subtract the
-        # remaining B/R residual component, clipped at 10% of itself)
-        var_sig_clean = max(var_sig - B / R, 0.1 * var_sig)
-        r_now = float(np.corrcoef(a_full, pred)[0, 1])
-        r_inf = float(np.sqrt(var_sig_clean / (var_sig_clean + A)))
+        B = max(B, 0.0)
+        var_tot = float(np.var(a_full))
+        # var(a_full) = explained + A + B/R. The converged correlation
+        # keeps the explained part and the repeat-INDEPENDENT floor A;
+        # only the B/R retraining-noise term averages out:
+        #   r_inf^2 = explained / (explained + A)
+        explained = max(var_tot - A - B / R, 0.05 * var_tot)
+        r_now = float(np.corrcoef(a_full, pred_v)[0, 1])
+        r_inf = float(np.sqrt(explained / (explained + A)))
         rows.append({
             "point": t, "rows": int(m.sum()), "repeats": R,
             "ladder_resid2": {str(k): v for k, v in ladder.items()},
