@@ -1,0 +1,85 @@
+"""bench._pipelined: window-sweep protocol logic (r5).
+
+Uses a stub engine — no device, no jax. Validates the r5 protocol
+properties: 4-batch stream depth, sweep over window in {1, 2, 4} with
+early stop at the batch count, best-window selection, and the
+overlap-occupancy diagnostic.
+"""
+
+import importlib.util
+import os
+import sys
+
+import numpy as np
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class _Result:
+    def __init__(self, n):
+        self.counts = np.full(n, 10)
+
+
+class _StubEngine:
+    """Records query_many calls; per-window wall time is simulated by
+    the caller reading .calls afterwards (throughput differences come
+    only from how many scores each call returns here)."""
+
+    def __init__(self, sps_by_window):
+        self.sps_by_window = sps_by_window
+        self.calls = []
+
+    def query_many(self, stream, batch_queries=256, window=4):
+        self.calls.append({"n": len(stream), "batch": batch_queries,
+                           "window": window})
+        n_batches = -(-len(stream) // batch_queries)
+        return [_Result(batch_queries) for _ in range(n_batches)]
+
+
+def test_stream_depth_and_sweep():
+    bench = _load_bench()
+    points = np.arange(512).reshape(256, 2)
+    eng = _StubEngine({})
+    out = bench._pipelined(eng, points, 256, seed=0)
+    # warmup + sweep calls; every timed stream is 4 batches deep
+    timed = eng.calls[1:]
+    assert all(c["n"] == 1024 and c["batch"] == 256 for c in timed)
+    assert [c["window"] for c in timed] == [1, 2, 4]
+    assert eng.calls[0]["n"] == 1024  # warmup covers the full stream
+    assert out["batches"] == 4
+    assert set(out["window_sweep"]) == {
+        "window1_scores_per_sec", "window2_scores_per_sec",
+        "window4_scores_per_sec"}
+    assert out["window"] in (1, 2, 4)
+    assert out["scores_per_sec"] == max(
+        out["window_sweep"].values())
+
+
+def test_stream_always_has_pipeline_depth():
+    bench = _load_bench()
+    # the r2-r4 regression was a 2-batch stream (no depth); the r5
+    # protocol must scale the stream to 4 batches even when the point
+    # set is smaller than the batch size
+    points = np.arange(128).reshape(64, 2)
+    eng = _StubEngine({})
+    out = bench._pipelined(eng, points, 256, seed=0)
+    assert out["batches"] >= 4
+    assert all(c["n"] >= 4 * 256 for c in eng.calls)
+
+
+def test_occupancy_diagnostic():
+    bench = _load_bench()
+    points = np.arange(512).reshape(256, 2)
+    eng = _StubEngine({})
+    out = bench._pipelined(eng, points, 256, seed=0,
+                           seq_scores_per_sec=1e9)
+    assert "overlap_occupancy" in out
+    assert out["overlap_occupancy"] > 0

@@ -1,0 +1,94 @@
+"""scripts/floor_ladder.py: the repeat-subsampling noise decomposition
+must recover known (floor, sigma) from synthetic per-repeat data.
+
+The generator mirrors the artifact contract of cli/rq1.py: repeat_y
+rows are per-removal per-repeat post-retrain predictions, the drift
+lane shares each repeat's seed (CRN), and actuals are paired
+mean-differences. resid^2(r) = floor^2 + sigma^2/r is planted exactly.
+"""
+
+import importlib.util
+import os
+
+import numpy as np
+import pytest
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location(
+        "floor_ladder", os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "scripts", "floor_ladder.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _make_artifact(path, floor, sigma, n=48, R=8, seed=0):
+    rng = np.random.default_rng(seed)
+    y0 = 3.0
+    pred = rng.normal(0.0, 0.01, n)
+    signal = 1.7 * pred
+    row_floor = rng.normal(0.0, floor, n)  # repeat-independent error
+    eps = rng.normal(0.0, sigma, (n, R))  # per-repeat retrain noise
+    drift_common = rng.normal(0.0, sigma, R)  # shared per-repeat shift
+    repeat_y = y0 + signal[:, None] + row_floor[:, None] + eps \
+        + drift_common[None, :]
+    drift = y0 + drift_common
+    np.savez(
+        path,
+        actual_loss_diffs=(repeat_y - drift[None, :]).mean(axis=1),
+        predicted_loss_diffs=pred,
+        indices_to_remove=np.arange(n),
+        test_index_of_row=np.full(n, 7),
+        repeat_y=repeat_y,
+        drift_repeat_y=drift[None, :],
+        y0_of_point=np.asarray([y0], np.float32),
+    )
+
+
+class TestFloorLadder:
+    def test_recovers_planted_components(self, tmp_path):
+        mod = _load()
+        p = str(tmp_path / "art.npz")
+        _make_artifact(p, floor=2e-3, sigma=6e-3, n=64, R=8, seed=1)
+        res = mod.analyze(p, max_draws=24)
+        (pt,) = res["points"]
+        assert pt["fit_r2"] > 0.9
+        assert 1e-3 < pt["floor_inf"] < 4e-3  # planted 2e-3
+        assert 4e-3 < pt["sigma_per_repeat"] < 9e-3  # planted 6e-3
+        # converged estimate must improve on the current correlation
+        # but stay below the no-floor ideal
+        assert pt["pearson_now"] < pt["pearson_converged_est"] <= 1.0
+
+    def test_pure_noise_point_converges_to_one(self, tmp_path):
+        mod = _load()
+        p = str(tmp_path / "art.npz")
+        _make_artifact(p, floor=0.0, sigma=8e-3, n=64, R=8, seed=2)
+        res = mod.analyze(p, max_draws=24)
+        (pt,) = res["points"]
+        assert pt["floor_inf"] < 1.5e-3
+        assert pt["pearson_converged_est"] > 0.95
+        assert pt["noise_dominated"]
+
+    def test_nan_repeats_tolerated(self, tmp_path):
+        mod = _load()
+        p = str(tmp_path / "art.npz")
+        _make_artifact(p, floor=2e-3, sigma=6e-3, n=48, R=4, seed=3)
+        d = dict(np.load(p))
+        d["repeat_y"][5, 1] = np.nan  # one dropped retrain outcome
+        np.savez(p, **d)
+        res = mod.analyze(p, max_draws=12)
+        (pt,) = res["points"]
+        assert np.isfinite(pt["floor_inf"])
+        assert np.isfinite(pt["pearson_converged_est"])
+
+    def test_misaligned_per_point_arrays_skipped(self, tmp_path):
+        mod = _load()
+        p = str(tmp_path / "art.npz")
+        _make_artifact(p, floor=1e-3, sigma=5e-3, n=32, R=4, seed=4)
+        d = dict(np.load(p))
+        d["drift_repeat_y"] = np.vstack([d["drift_repeat_y"]] * 2)
+        np.savez(p, **d)
+        res = mod.analyze(p)
+        assert "skipped" in res
