@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""Per-point budget-ladder comparison of two RQ1 artifacts.
+
+VERDICT r4 weak #3 asked whether the MF wide-sample's depressed slopes
+(0.63-0.95 at the truncated 2k x 2 budget) vanish at the reference's
+full 24k x 4 budget. The r5 chain measures the SAME eight seed-17 test
+points at both budgets; this script pairs them per point and reports
+Pearson r and the OLS slope (actual ~ predicted) side by side, plus
+pooled values.
+
+Usage: python scripts/budget_ladder.py LOW.npz HIGH.npz
+       [--out output/budget_ladder_<model>.json]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+
+def per_point(path):
+    d = np.load(path)
+    g = d["test_index_of_row"]
+    a = np.asarray(d["actual_loss_diffs"], np.float64)
+    p = np.asarray(d["predicted_loss_diffs"], np.float64)
+    out = {}
+    for t in np.unique(g):
+        m = (g == t) & np.isfinite(a) & np.isfinite(p)
+        if m.sum() < 3:
+            continue
+        aa, pp = a[m], p[m]
+        slope = float(np.polyfit(pp, aa, 1)[0])
+        out[int(t)] = {
+            "n": int(m.sum()),
+            "r": float(np.corrcoef(aa, pp)[0, 1]),
+            "slope": slope,
+        }
+    proto = (f"{int(d['protocol'][0])}x{int(d['protocol'][1])}"
+             if "protocol" in d.files else "?")
+    pooled_m = np.isfinite(a) & np.isfinite(p)
+    pooled = float(np.corrcoef(a[pooled_m], p[pooled_m])[0, 1])
+    return proto, pooled, out
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("low")
+    ap.add_argument("high")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+    proto_lo, pooled_lo, lo = per_point(args.low)
+    proto_hi, pooled_hi, hi = per_point(args.high)
+    shared = sorted(set(lo) & set(hi))
+    if not shared:
+        raise SystemExit("no shared test points between the artifacts")
+    rows = []
+    print(f"{'point':>7} | {proto_lo:>9} r/slope | {proto_hi:>9} r/slope")
+    for t in shared:
+        l, h = lo[t], hi[t]
+        print(f"{t:>7} | {l['r']:.4f} / {l['slope']:.3f}   | "
+              f"{h['r']:.4f} / {h['slope']:.3f}")
+        rows.append({"point": t, "low": l, "high": h})
+    sl = [r["low"]["slope"] for r in rows]
+    sh = [r["high"]["slope"] for r in rows]
+    print(f"pooled r: {pooled_lo:.4f} ({proto_lo}) -> "
+          f"{pooled_hi:.4f} ({proto_hi})")
+    print(f"slope range: [{min(sl):.3f}, {max(sl):.3f}] -> "
+          f"[{min(sh):.3f}, {max(sh):.3f}]")
+    out = {
+        "low": {"file": os.path.basename(args.low), "protocol": proto_lo,
+                "pooled_r": pooled_lo},
+        "high": {"file": os.path.basename(args.high),
+                 "protocol": proto_hi, "pooled_r": pooled_hi},
+        "points": rows,
+        "slope_range_low": [min(sl), max(sl)],
+        "slope_range_high": [min(sh), max(sh)],
+    }
+    path = args.out or os.path.join("output", "budget_ladder.json")
+    with open(path + ".tmp", "w") as fh:
+        json.dump(out, fh, indent=1)
+    os.replace(path + ".tmp", path)
+    print(f"wrote {path}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
