@@ -193,7 +193,11 @@ class TestCLI:
             "--num_to_remove", "6",
         ])
         assert np.isfinite(r)
-        art = np.load(tmp_path / "RQ1-MF-synthetic.npz")
+        # r5 contract: explicit-indices runs ALWAYS divert to the
+        # -pt<ids> path (even into an empty train_dir) so they can
+        # never claim a canonical name a full run owns; merge via
+        # scripts/merge_rq1.py
+        art = np.load(tmp_path / "RQ1-MF-synthetic-pt7-3.npz")
         assert set(art["test_index_of_row"]) == {7, 3}
         # per-repeat retrain outcomes ride in the artifact (r4: the
         # noise-floor decomposition runs from the npz alone)
