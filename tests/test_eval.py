@@ -158,6 +158,26 @@ class TestMergeRQ1:
         assert "repeat_y" not in out
         assert sorted(set(out["test_index_of_row"])) == [1, 2]
 
+    def test_model_key_carries_when_inputs_agree(self, tmp_path):
+        mod = _load_script("merge_rq1")
+
+        def add_key(path, key):
+            d = dict(np.load(path))
+            d["model_key"] = np.asarray(key)
+            np.savez(path, **d)
+
+        self._write(tmp_path / "a.npz", [1])
+        self._write(tmp_path / "b.npz", [2])
+        add_key(tmp_path / "a.npz", "cfg")
+        add_key(tmp_path / "b.npz", "cfg")
+        out = mod.merge([str(tmp_path / "a.npz"), str(tmp_path / "b.npz")])
+        assert str(out["model_key"]) == "cfg"
+        # disagreement (or one legacy input) drops it — downgrading
+        # the merged artifact to always-divert, the safe direction
+        add_key(tmp_path / "b.npz", "other_cfg")
+        out = mod.merge([str(tmp_path / "a.npz"), str(tmp_path / "b.npz")])
+        assert "model_key" not in out
+
 
 
 class TestRQ1ArtifactPath:
