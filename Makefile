@@ -1,0 +1,12 @@
+# Convenience targets. The commands themselves are pinned in
+# ROADMAP.md (tier-1) and scripts/ — these targets just name them.
+
+.PHONY: tier1 test
+
+# The ROADMAP.md tier-1 verify: fast CPU suite, slow tests excluded.
+tier1:
+	bash scripts/tier1.sh
+
+# Full suite (includes slow-marked tests; needs more wall clock).
+test:
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q -p no:cacheprovider

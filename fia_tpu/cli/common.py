@@ -120,6 +120,18 @@ def base_parser(desc: str) -> argparse.ArgumentParser:
                         "or 'cal' (cal2-style waterfilled unique pairs "
                         "— scales with no reference heldout, e.g. "
                         "ML-20M fidelity rows)")
+    # reliability (fia_tpu/reliability): preemption-tolerant execution
+    p.add_argument("--resume", action="store_true",
+                   help="resume an interrupted chain from its progress "
+                        "journal: completed test points are loaded, not "
+                        "recomputed (journal fingerprint must match — a "
+                        "mismatch fails loudly rather than stitching "
+                        "rows from a different run)")
+    p.add_argument("--deadline", type=float, default=0.0,
+                   help="wall-clock budget in seconds (0 = none); the "
+                        "chain stops cleanly between test points when "
+                        "the budget is spent, with all completed points "
+                        "journaled for --resume")
     return p
 
 

@@ -16,6 +16,8 @@ import os
 import numpy as np
 
 from fia_tpu.cli import common
+from fia_tpu.reliability import policy as rpolicy
+from fia_tpu.reliability.journal import Journal
 from fia_tpu.utils.io import save_npz_atomic
 
 
@@ -71,10 +73,28 @@ def artifact_path(train_dir, model, dataset, args, test_indices, tag,
             + (f"-seed{proto[5]}" if proto[5] else ""))
 
     def digested(path):
+        """Last rung of the divert ladder: suffix the model_key digest.
+
+        The digested path is checked for occupancy too — it is 8 hex
+        chars of sha1(model_key), so two different model configs CAN
+        collide there. A collision means every rung of the ladder is
+        occupied by some other run; clobbering silently at the bottom
+        rung would be exactly the artifact-loss bug class the ladder
+        exists to prevent, so fail loudly instead (r5 advisor finding).
+        """
         import hashlib
 
         digest = hashlib.sha1(model_key.encode()).hexdigest()[:8]
-        return path[: -len(".npz")] + f"-m{digest}.npz"
+        dpath = path[: -len(".npz")] + f"-m{digest}.npz"
+        if occupied_by_other(dpath):
+            raise SystemExit(
+                f"artifact ladder exhausted: {dpath} is already banked "
+                f"by a different run (model_key digest collision at "
+                f"m{digest}). Refusing to clobber hours of banked rows "
+                "— move the existing artifact aside or change "
+                "--train_dir."
+            )
+        return dpath
 
     canonical = os.path.join(train_dir, f"RQ1-{model}-{dataset}.npz")
     if args.test_indices:
@@ -148,33 +168,32 @@ def main(argv=None):
     if os.path.basename(art_path) != f"RQ1-{args.model}-{args.dataset}.npz":
         print(f"existing artifact kept; rows -> {art_path}")
 
+    # Resumable chain (fia_tpu/reliability): each completed test point is
+    # journaled next to its artifact with the exact arrays the npz rows
+    # are built from, so a killed chain restarted with --resume recomputes
+    # ZERO completed points and emits a byte-identical npz. The journal
+    # fingerprint binds the rows to this exact run (model config, retrain
+    # protocol, stream, test indices) — a mismatched --resume fails loudly
+    # (JournalMismatch) rather than stitching rows from a different run.
+    jpath = os.path.join(
+        args.train_dir,
+        "." + os.path.basename(art_path)[: -len(".npz")] + ".journal.jsonl",
+    )
+    fingerprint = {
+        "kind": "rq1-chain",
+        "model_key": model_key,
+        "protocol": [args.num_steps_retrain, args.retrain_times,
+                     args.num_to_remove, args.num_test, int(args.maxinf),
+                     args.seed],
+        "stream_tag": tag or "",
+        "test_indices": [int(i) for i in test_indices],
+    }
+    deadline = rpolicy.Deadline(args.deadline)
+
     actuals, predictions, removed = [], [], []
     repeat_rows, drift_rows, y0s = [], [], []
-    for t in test_indices:
-        res = test_retraining(
-            engine, train, test, int(t),
-            num_to_remove=args.num_to_remove,
-            num_steps=args.num_steps_retrain,
-            batch_size=batch,
-            learning_rate=args.lr,
-            retrain_times=args.retrain_times,
-            remove_type="maxinf" if args.maxinf else "random",
-            lane_chunk=args.lane_chunk,
-            steps_per_dispatch=args.steps_per_dispatch,
-            mesh=mesh, event_log=log,
-        )
-        r = pearson(res.actual_y_diffs, res.predicted_y_diffs)
-        print(f"test {int(t)}: pearson r = {r:.4f} "
-              f"(bias_retrain {res.bias_retrain:+.5f})")
-        log.log("test_point_done", test_idx=int(t), pearson=float(r),
-                bias_retrain=float(res.bias_retrain))
-        actuals.append(res.actual_y_diffs)
-        predictions.append(res.predicted_y_diffs)
-        removed.append(res.indices_to_remove)
-        repeat_rows.append(res.per_repeat_y[:-1])
-        drift_rows.append(res.per_repeat_y[-1])
-        y0s.append(res.y0)
 
+    def bank_rows():
         # per-test-point rows can be ragged (a test point's related set
         # may hold fewer than num_to_remove rows), so stack as flat
         # arrays plus per-row test-point ids rather than a (T, R) matrix.
@@ -204,6 +223,76 @@ def main(argv=None):
             stream_tag=np.asarray(tag),
             model_key=np.asarray(model_key),
         )
+
+    saved = False
+    with Journal.open(jpath, fingerprint, resume=args.resume) as journal:
+        for t in test_indices:
+            point_key = f"point:{int(t)}"
+            if journal.done(point_key):
+                p = journal.get(point_key)
+                actuals.append(p["actual_y_diffs"])
+                predictions.append(p["predicted_y_diffs"])
+                removed.append(p["indices_to_remove"])
+                repeat_rows.append(p["per_repeat_y"][:-1])
+                drift_rows.append(p["per_repeat_y"][-1])
+                y0s.append(p["y0"])
+                print(f"test {int(t)}: restored from journal "
+                      f"(pearson r = {p['pearson']:.4f})")
+                log.log("test_point_restored", test_idx=int(t),
+                        pearson=float(p["pearson"]))
+                continue
+            # a spent wall-clock budget stops the chain cleanly BETWEEN
+            # points — but never before at least one point is banked, so
+            # every run makes forward progress for --resume to build on
+            if deadline.expired() and actuals:
+                print(f"[reliability] deadline ({args.deadline:g}s) "
+                      f"reached after {len(actuals)} point(s); rerun "
+                      "with --resume to continue")
+                log.log("deadline_stop", points_done=len(actuals))
+                break
+            res = test_retraining(
+                engine, train, test, int(t),
+                num_to_remove=args.num_to_remove,
+                num_steps=args.num_steps_retrain,
+                batch_size=batch,
+                learning_rate=args.lr,
+                retrain_times=args.retrain_times,
+                remove_type="maxinf" if args.maxinf else "random",
+                lane_chunk=args.lane_chunk,
+                steps_per_dispatch=args.steps_per_dispatch,
+                mesh=mesh, event_log=log,
+            )
+            r = pearson(res.actual_y_diffs, res.predicted_y_diffs)
+            print(f"test {int(t)}: pearson r = {r:.4f} "
+                  f"(bias_retrain {res.bias_retrain:+.5f})")
+            log.log("test_point_done", test_idx=int(t), pearson=float(r),
+                    bias_retrain=float(res.bias_retrain))
+            actuals.append(res.actual_y_diffs)
+            predictions.append(res.predicted_y_diffs)
+            removed.append(res.indices_to_remove)
+            repeat_rows.append(res.per_repeat_y[:-1])
+            drift_rows.append(res.per_repeat_y[-1])
+            y0s.append(res.y0)
+
+            bank_rows()
+            saved = True
+            # journal AFTER the npz save: a crash between the two leaves
+            # the point un-journaled and it is simply recomputed (and the
+            # npz idempotently rewritten) on --resume
+            journal.record(point_key, {
+                "actual_y_diffs": np.asarray(res.actual_y_diffs),
+                "predicted_y_diffs": np.asarray(res.predicted_y_diffs),
+                "indices_to_remove": np.asarray(res.indices_to_remove),
+                "per_repeat_y": np.asarray(res.per_repeat_y),
+                "y0": float(res.y0),
+                "pearson": float(r),
+                "bias_retrain": float(res.bias_retrain),
+            })
+    if actuals and not saved:
+        # every point came from the journal (e.g. the killed run died
+        # after its last point's journal append but before exit, or the
+        # artifact was removed) — rewrite the npz from the restored rows
+        bank_rows()
 
     a = np.concatenate(actuals)
     p = np.concatenate(predictions)

@@ -36,6 +36,9 @@ from fia_tpu.data.index import InteractionIndex, bucketed_pad
 from fia_tpu.influence import grads as G
 from fia_tpu.influence import hvp as H
 from fia_tpu.influence import solvers
+from fia_tpu.reliability import inject, taxonomy
+from fia_tpu.reliability import policy as rpolicy
+from fia_tpu.reliability.journal import Journal  # noqa: F401 (re-export)
 
 
 class InfluenceResult:
@@ -115,50 +118,26 @@ class InfluenceResult:
 def _classify_device_failure(e: Exception) -> str | None:
     """Classify a dispatch/compile failure for the adaptive retry layer.
 
-    Returns:
-      ``"oom"`` — the backend said so explicitly (RESOURCE_EXHAUSTED /
-        "Ran out of memory"): definite evidence, safe to persist in the
-        cross-process memory envelope.
-      ``"ambiguous"`` — tunnel-attached TPUs (axon remote compile) wrap
-        the XLA error in a generic "HTTP 500: tpu_compile_helper
-        subprocess exit code N" whose OOM detail only reaches stderr.
-        Could be OOM (observed: 256-query NCF batch at pad 4608, 16.06G
-        of 15.75G HBM) or a transient tunnel fault: the adaptive layer
-        retries ONCE at the same size before halving, and keeps what it
-        learns from these in-process only — one flaky HTTP 500 must not
-        poison the shared envelope for every later process (r3 advisor
-        finding).
-      ``None`` — unrelated failure; re-raise.
+    Delegates to the unified taxonomy in
+    :mod:`fia_tpu.reliability.taxonomy` — the classifier grew up here
+    (r3/r4; the per-kind histories live in that module's docstring) and
+    was lifted out so the trainer, distributed runtime and CLI drivers
+    share exactly the same signatures. The name stays importable from
+    this module: it is the documented seam tests and operators key on.
+
+    Returns a :class:`~fia_tpu.reliability.taxonomy.FaultKind` string
+    (``"oom"`` / ``"ambiguous"`` / ``"worker"`` / ``"preemption"`` /
+    ``"host_oom"`` / ``"nan"`` / ``"deadline"``) or ``None`` for
+    unrelated failures, which callers must re-raise.
     """
-    s = str(e)
-    if "RESOURCE_EXHAUSTED" in s or "out of memory" in s.lower():
-        return "oom"
-    if "tpu_compile_helper subprocess exit code" in s:
-        return "ambiguous"
-    if (
-        "worker process crashed or restarted" in s
-        or "kernel fault" in s
-        or ("UNAVAILABLE" in s and "TPU worker" in s)
-        # the r4 k=256 crash's terse runtime form ("INTERNAL: TPU
-        # backend error (Internal)."); compile/lowering internals that
-        # happen to share the phrase must NOT trigger retry-at-half
-        # cascades — each halved shape is a fresh 40-66 s compile that
-        # would fail identically
-        or (
-            "TPU backend error" in s
-            and not any(
-                k in s for k in ("compile", "lower", "Mosaic")
-            )
-        )
-    ):
-        # The r3 k=256 failure mode: the TPU worker process died at
-        # RUNTIME (not an XLA OOM — those fail at compile). Observed at
-        # 64-query k=256 batches whose (chunk, 514, 514) accumulation
-        # buffer reached 2.2 GB. Every device buffer this client held
-        # is gone; recovery needs a device-state rebuild plus a
-        # smaller dispatch (engine._reset_device_state + retry-at-half).
-        return "worker"
-    return None
+    return taxonomy.classify(e)
+
+
+# Kinds the padded adaptive layer knows how to absorb; anything else
+# surfaces (host_oom/nan/deadline have their own dedicated layers).
+_ADAPTIVE_KINDS = frozenset(
+    {taxonomy.OOM, taxonomy.AMBIGUOUS, taxonomy.WORKER, taxonomy.PREEMPTION}
+)
 
 
 def _concat_results(parts: list["InfluenceResult"]) -> "InfluenceResult":
@@ -235,6 +214,7 @@ class InfluenceEngine:
         flat_chunk: int = 2048,
         flat_accum: str = "auto",
         row_features: str = "auto",
+        cpu_fallback: bool = True,
     ):
         if solver not in ("direct", "cg", "lissa", "schulz"):
             raise ValueError(f"unknown solver {solver!r}")
@@ -371,6 +351,13 @@ class InfluenceEngine:
         # clears stale cached ceilings <= this size. 0 = none.
         self._cleared_bad = 0
         self._memkey = None
+        # Last rung of the query degradation ladder: when device-side
+        # recovery is exhausted (worker keeps dying at single-query
+        # dispatches), rebuild the engine from its host copies on the
+        # CPU backend and finish there — slow but correct beats dead.
+        self.cpu_fallback = bool(cpu_fallback)
+        self._is_cpu_fallback = False
+        self._cpu_engine: "InfluenceEngine | None" = None
 
     def _upload_device_state(self) -> None:
         """(Re)build every device-resident tensor from host copies.
@@ -385,6 +372,7 @@ class InfluenceEngine:
         every jit operand must be a global array; params (unless
         table-sharded) and train tensors are replicated.
         """
+        inject.fire("engine.upload")
         mesh = self.mesh
         self.params = jax.tree_util.tree_map(jnp.asarray, self._params_host)
         if self._shard_tables:
@@ -454,26 +442,23 @@ class InfluenceEngine:
         The worker takes seconds to come back after a crash — the r4
         k=256 retry died AGAIN at ``device_put`` time because the
         re-upload raced the restart — so upload failures that still
-        carry the worker-death signature back off exponentially up to
-        ``max_wait_s`` before surfacing.
+        carry the worker-death (or preemption) signature back off
+        exponentially up to ``max_wait_s`` before surfacing. The
+        schedule is a reliability :class:`RetryPolicy` under a
+        :class:`Deadline` — deterministic jitter, replayable under
+        fault injection.
         """
-        import time as _time
-
         self._jitted.clear()
-        deadline = _time.monotonic() + max_wait_s
-        delay = 2.0
-        while True:
-            try:
-                self._upload_device_state()
-                return
-            except Exception as e:
-                if (
-                    _classify_device_failure(e) != "worker"
-                    or _time.monotonic() + delay > deadline
-                ):
-                    raise
-                _time.sleep(delay)
-                delay = min(delay * 2.0, 30.0)
+        # 8 attempts at 2s base / x2 growth / 30s cap spans ~120s of
+        # backoff — the observed worker-restart envelope.
+        pol = rpolicy.RetryPolicy(
+            max_attempts=8, base_delay=2.0, max_delay=30.0, jitter=0.25
+        )
+        pol.run(
+            self._upload_device_state,
+            retry_on=(taxonomy.WORKER, taxonomy.PREEMPTION),
+            deadline=rpolicy.Deadline(max_wait_s),
+        )
 
     # -- the pure per-test-point query ------------------------------------
     def _query_one(self, params, train_x, train_y, postings, u, i, test_x,
@@ -832,6 +817,7 @@ class InfluenceEngine:
         """Enqueue one flat query program; returns an opaque handle for
         :meth:`_finalize_flat`. Dispatch is async — the device starts
         crunching while the host moves on."""
+        inject.fire("engine.dispatch_flat")
         counts = self.index.counts_batch(test_points)
         total = int(counts.sum())
         # geometric bucketing (~12.5% granule): pure powers of two waste
@@ -879,11 +865,21 @@ class InfluenceEngine:
             )
         except Exception as e:
             T = len(test_points)
-            if (
-                _classify_device_failure(e) != "worker"
-                or _depth >= 3
-                or T <= 1
-            ):
+            cls = _classify_device_failure(e)
+            if cls == taxonomy.PREEMPTION and _depth < 3:
+                # Preemption carries no size evidence: rebuild (the
+                # reset's own backoff waits out the reclaim window) and
+                # retry at the SAME size. _depth bounds a permanently
+                # reclaimed slice.
+                self._reset_device_state()
+                return self._query_flat(test_points, pad_to, _depth + 1)
+            if cls != taxonomy.WORKER or _depth >= 3 or T <= 1:
+                if cls in _ADAPTIVE_KINDS:
+                    # Ladder exhausted on a device-side fault: last rung
+                    # is the CPU backend (None when unavailable/disabled).
+                    cpu = self._query_on_cpu(test_points, pad_to)
+                    if cpu is not None:
+                        return cpu
                 raise
             # Bounded retry-at-half after a TPU worker crash (the r3
             # k=256 failure: 64-query batches killed the worker twice,
@@ -896,6 +892,52 @@ class InfluenceEngine:
                 self._query_flat(test_points[:h], pad_to, _depth + 1),
                 self._query_flat(test_points[h:], pad_to, _depth + 1),
             ])
+
+    def _query_on_cpu(
+        self, test_points: np.ndarray, pad_to: int | None
+    ) -> InfluenceResult | None:
+        """Final degradation rung: answer the query on the CPU backend.
+
+        Rebuilds a single-device engine from the host copies that
+        survive any device failure (``_params_host``/``_train_host``)
+        under ``jax.default_device(cpu)``. Returns ``None`` when the
+        rung does not apply (disabled, already the fallback, mesh
+        engines whose global arrays have no CPU analogue, or no CPU
+        backend) so callers surface the original failure instead.
+        """
+        if not self.cpu_fallback or self._is_cpu_fallback:
+            return None
+        if self.mesh is not None:
+            return None
+        try:
+            cpu0 = jax.local_devices(backend="cpu")[0]
+        except Exception:
+            return None
+        if self._cpu_engine is None:
+            print(
+                "[reliability] device-side recovery exhausted; "
+                "degrading to the CPU backend for this query"
+            )
+            with jax.default_device(cpu0):
+                eng = InfluenceEngine(
+                    self.model,
+                    self._params_host,
+                    RatingDataset(*self._train_host),
+                    damping=self.damping,
+                    solver=self.solver,
+                    cg_maxiter=self.cg_maxiter,
+                    cg_tol=self.cg_tol,
+                    lissa_scale=self.lissa_scale,
+                    lissa_depth=self.lissa_depth,
+                    model_name=self.model_name + "-cpufb",
+                    pad_bucket=self.pad_bucket,
+                    hessian_mode="auto",
+                    impl="auto",
+                )
+                eng._is_cpu_fallback = True
+            self._cpu_engine = eng
+        with jax.default_device(cpu0):
+            return self._cpu_engine.query_batch(test_points, pad_to=pad_to)
 
     def _wide_block_cap(self) -> bool:
         """Proactive dispatch cap for very wide blocks: the d=514
@@ -918,6 +960,8 @@ class InfluenceEngine:
         batch_queries: int = 256,
         pad_to: int | None = None,
         window: int = 4,
+        journal: "Journal | None" = None,
+        deadline: "rpolicy.Deadline | None" = None,
     ) -> list[InfluenceResult]:
         """Pipelined large workloads: split into query batches, keep up
         to ``window`` device programs in flight, and finalize in order.
@@ -928,6 +972,14 @@ class InfluenceEngine:
         device compute. Falls back to sequential :meth:`query_batch`
         whenever the flat path is ineligible. The bounded window caps
         device-resident output buffers for very long workloads.
+
+        ``journal``: a reliability :class:`Journal` (open it against
+        :meth:`journal_fingerprint`); each finalized batch is recorded
+        durably, and batches already journaled are reconstructed from
+        the journal instead of recomputed — a killed workload resumes
+        where it stopped. ``deadline``: a reliability ``Deadline``;
+        expiry between batches raises ``DeadlineExpired`` with every
+        completed batch already journaled (a clean, resumable stop).
         """
         test_points = np.asarray(test_points)
         if test_points.ndim == 1:
@@ -938,32 +990,120 @@ class InfluenceEngine:
             test_points[i : i + batch_queries]
             for i in range(0, len(test_points), batch_queries)
         ]
+        results: list[InfluenceResult | None] = [None] * len(batches)
+        todo: list[int] = []
+        for k in range(len(batches)):
+            if journal is not None and journal.done(f"batch:{k}"):
+                results[k] = self._result_from_journal(
+                    journal.get(f"batch:{k}")
+                )
+            else:
+                todo.append(k)
+
+        def bank(k: int, res: InfluenceResult) -> None:
+            results[k] = res
+            if journal is not None:
+                journal.record(f"batch:{k}", self._journal_payload(res))
+
         if not (self.impl in ("auto", "flat") and self._flat_eligible()):
-            return [self.query_batch(b, pad_to=pad_to) for b in batches]
-        results: list[InfluenceResult] = []
+            for k in todo:
+                if deadline is not None:
+                    deadline.check("query_many (sequential)")
+                bank(k, self.query_batch(batches[k], pad_to=pad_to))
+            return results
         done = 0  # finalize order == dispatch order == batch order
         try:
             inflight: list = []
-            for b in batches:
-                inflight.append(self._dispatch_flat(b, pad_to))
+            for k in todo:
+                if deadline is not None:
+                    deadline.check("query_many (dispatch)")
+                inflight.append((k, self._dispatch_flat(batches[k], pad_to)))
                 if len(inflight) >= max(1, window):
-                    results.append(self._finalize_flat(inflight.pop(0)))
+                    j, h = inflight.pop(0)
+                    bank(j, self._finalize_flat(h))
                     done += 1
             while inflight:
-                results.append(self._finalize_flat(inflight.pop(0)))
+                j, h = inflight.pop(0)
+                bank(j, self._finalize_flat(h))
                 done += 1
         except Exception as e:
-            if _classify_device_failure(e) != "worker":
+            if _classify_device_failure(e) not in (
+                taxonomy.WORKER, taxonomy.PREEMPTION
+            ):
                 raise
-            # A worker crash kills every in-flight dispatch at once.
-            # Rebuild device state and run the unfinalized remainder
-            # sequentially through _query_flat, whose own bounded
-            # halving absorbs a recurring crash; already-finalized
-            # results are host numpy and stay valid.
+            # A worker crash/preemption kills every in-flight dispatch
+            # at once. Rebuild device state and run the unfinalized
+            # remainder sequentially through _query_flat, whose own
+            # bounded halving (and CPU last rung) absorbs a recurring
+            # crash; already-finalized results are host numpy and stay
+            # valid — and journaled, when a journal is attached.
             self._reset_device_state()
-            for b in batches[done:]:
-                results.append(self._query_flat(b, pad_to))
+            for k in todo[done:]:
+                bank(k, self._query_flat(batches[k], pad_to))
         return results
+
+    # -- resumable-execution plumbing --------------------------------------
+    def journal_fingerprint(self, test_points: np.ndarray,
+                            batch_queries: int = 256,
+                            pad_to: int | None = None, **extra) -> dict:
+        """Identity of a :meth:`query_many` workload for journal binding.
+
+        Two runs share journal progress iff model/solver/config, the
+        test-point stream AND the batch split agree — anything less and
+        a resumed run would stitch batches computed under a different
+        regime. ``extra`` lets callers fold in their own provenance.
+        """
+        import hashlib
+
+        tp = np.ascontiguousarray(np.asarray(test_points, np.int64))
+        return {
+            "kind": "query_many",
+            "model": self.model_name,
+            "solver": self.solver,
+            "damping": repr(self.damping),
+            "pad_bucket": self.pad_bucket,
+            "batch_queries": int(batch_queries),
+            "pad_to": None if pad_to is None else int(pad_to),
+            "n_points": int(tp.shape[0]) if tp.ndim > 1 else 1,
+            "points_sha1": hashlib.sha1(tp.tobytes()).hexdigest(),
+            **extra,
+        }
+
+    def _journal_payload(self, res: InfluenceResult) -> dict:
+        """JSON-packable form of one batch result (exact round-trip)."""
+        base = {
+            "counts": np.asarray(res.counts),
+            "ihvp": np.asarray(res.ihvp),
+            "test_grad": np.asarray(res.test_grad),
+        }
+        if res._packed is not None:
+            base.update(
+                fmt="packed",
+                packed=np.asarray(res._packed),
+                test_points=np.asarray(res._test_points),
+                pad=int(res._pad),
+            )
+        else:
+            base.update(
+                fmt="dense",
+                scores=np.asarray(res.scores),
+                related_idx=np.asarray(res.related_idx),
+                related_mask=np.asarray(res.related_mask),
+            )
+        return base
+
+    def _result_from_journal(self, p: dict) -> InfluenceResult:
+        if p["fmt"] == "packed":
+            return InfluenceResult(
+                counts=p["counts"], ihvp=p["ihvp"],
+                test_grad=p["test_grad"], packed=p["packed"],
+                test_points=p["test_points"], index=self.index,
+                pad=int(p["pad"]),
+            )
+        return InfluenceResult(
+            p["scores"], p["related_idx"], p["related_mask"],
+            p["counts"], p["ihvp"], p["test_grad"],
+        )
 
     def _assemble_packed(self, test_points, counts, out, pad: int) -> InfluenceResult:
         """Wrap flat device outputs as a packed (lazily padded) result.
@@ -986,6 +1126,10 @@ class InfluenceEngine:
             )
         else:
             packed, ihvp, v = jax.device_get(out)
+        # NaN injection site: a diverged solve returns a "successful"
+        # buffer — corruption (and detection) happens on the fetched
+        # host payload, exactly like the real failure mode.
+        ihvp = inject.corrupt("engine.solve", np.asarray(ihvp))
         total = int(counts.sum())
         return InfluenceResult(
             counts=counts,
@@ -1040,7 +1184,53 @@ class InfluenceEngine:
           test_ratings: unused by the prediction-influence path (the test
             vector is ∇r̂, not ∇loss); accepted for API symmetry.
           pad_to: force a single fixed pad length (disables grouping).
+
+        Results are screened for non-finite payloads (the iHVP
+        silent-wrong-answer class: a diverged LiSSA/Schulz recursion
+        returns a "successful" NaN buffer). On detection the engine
+        escalates down the solver degradation ladder
+        (``lissa → cg → direct``, ``schulz → direct``) and recomputes —
+        see :meth:`_nan_ladder`.
         """
+        res = self._query_batch_impl(test_points, pad_to)
+        return self._nan_ladder(
+            res, lambda: self._query_batch_impl(test_points, pad_to)
+        )
+
+    def _nan_ladder(self, res: InfluenceResult, recompute) -> InfluenceResult:
+        """Escalate the solver until the payload is finite (or the
+        ladder bottoms out at the exact direct solve).
+
+        Escalation is sticky — the engine keeps the more robust solver
+        for subsequent batches (the block spectrum that diverged once
+        will diverge again) — and drops compiled programs, since the
+        solver choice is baked into the traced query functions.
+        """
+        while taxonomy.classify_payload(
+            res.ihvp, res.test_grad, res._packed, res._scores
+        ) is not None:
+            nxt = rpolicy.next_solver(self.solver)
+            if nxt is None:
+                print(
+                    "[reliability] non-finite influence payload from the "
+                    f"{self.solver!r} solver with no fallback rung left; "
+                    "returning as-is (check damping/conditioning)"
+                )
+                return res
+            print(
+                "[reliability] non-finite influence payload from "
+                f"{self.solver!r}; escalating solver to {nxt!r}"
+            )
+            self.solver = nxt
+            self._jitted.clear()
+            res = recompute()
+        return res
+
+    def _query_batch_impl(
+        self,
+        test_points: np.ndarray,
+        pad_to: int | None = None,
+    ) -> InfluenceResult:
         test_points = np.asarray(test_points)
         if test_points.ndim == 1:
             test_points = test_points[None, :]
@@ -1242,20 +1432,32 @@ class InfluenceEngine:
                 # new shape is a fresh 40-66 s XLA compile through the
                 # tunnel (T is a power of two in every real workload).
                 chunk = 1 << (chunk.bit_length() - 1)
+        # Preemptions carry no size evidence: rebuild and retry at the
+        # SAME size, bounded so a permanently reclaimed slice surfaces.
+        preempt_left = 3
         if chunk >= T:
             try:
                 out = self._dispatch_padded_resilient(test_points, pad)
             except Exception as e:
                 cls = _classify_device_failure(e)
-                if T <= 1 or cls is None:
+                if cls not in _ADAPTIVE_KINDS or (
+                    T <= 1 and cls != taxonomy.PREEMPTION
+                ):
                     raise
-                if cls == "worker":
+                if cls == taxonomy.PREEMPTION:
+                    preempt_left -= 1
+                    if preempt_left < 0:
+                        raise
+                    self._reset_device_state()
+                    # fall into the chunked loop at the same size
+                elif cls == taxonomy.WORKER:
                     # not memory evidence — rebuild the dead device
                     # state and halve, teaching the envelope nothing
                     self._reset_device_state()
+                    chunk = max(1, T // 2)
                 else:
-                    self._record_bad(T * pad, cls == "oom")
-                chunk = max(1, T // 2)
+                    self._record_bad(T * pad, cls == taxonomy.OOM)
+                    chunk = max(1, T // 2)
             else:
                 # Record fast-path successes too: otherwise one
                 # misclassified transient failure would permanently
@@ -1295,12 +1497,18 @@ class InfluenceEngine:
                 )
             except Exception as e:
                 cls = _classify_device_failure(e)
-                if n <= 1 or cls is None:
+                if cls == taxonomy.PREEMPTION:
+                    preempt_left -= 1
+                    if preempt_left < 0:
+                        raise
+                    self._reset_device_state()
+                    continue  # same size: no size evidence
+                if n <= 1 or cls not in _ADAPTIVE_KINDS:
                     raise
-                if cls == "worker":
+                if cls == taxonomy.WORKER:
                     self._reset_device_state()
                 else:
-                    self._record_bad(n * pad, cls == "oom")
+                    self._record_bad(n * pad, cls == taxonomy.OOM)
                 chunk = max(1, n // 2)
                 continue
             self._record_ok(n * pad)
@@ -1317,6 +1525,7 @@ class InfluenceEngine:
         batch's related-row total); chunked dispatches of one batch
         pass a common value so they share one compiled program.
         """
+        inject.fire("engine.dispatch_padded")
         counts = self.index.counts_batch(test_points)
         m = counts.max() if counts.size else 1
         if pad_to is None and self.pad_policy == "dataset":
@@ -1368,6 +1577,7 @@ class InfluenceEngine:
             )
         else:
             scores, ihvp, v = jax.device_get(out)
+        ihvp = inject.corrupt("engine.solve", np.asarray(ihvp))
         # Result row ids/mask come from the host CSR (same ordering as the
         # device gather: user postings then item postings) — cheap, and it
         # avoids shipping (T, P) int/bool arrays back over the interconnect.

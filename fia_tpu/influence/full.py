@@ -28,6 +28,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from fia_tpu.data.dataset import RatingDataset
 from fia_tpu.influence import solvers
+from fia_tpu.reliability import inject
+from fia_tpu.reliability import policy as rpolicy
 
 
 class FullInfluenceEngine:
@@ -47,6 +49,7 @@ class FullInfluenceEngine:
         #   variance when lissa_batch > 0 makes the HVPs stochastic
         hvp_batch: int = 0,  # 0 = one full-batch HVP program; >0 = scan
         mesh: Mesh | None = None,
+        residual_guard: float | None = None,
     ):
         self.model = model
         self.damping = float(damping)
@@ -57,6 +60,14 @@ class FullInfluenceEngine:
         self.lissa_depth = int(lissa_depth)
         self.lissa_batch = int(lissa_batch)
         self.lissa_samples = int(lissa_samples)
+        # Divergence guard for get_inverse_hvp: when set, a solve whose
+        # relative residual exceeds this (or is non-finite) escalates
+        # down the lissa -> cg ladder instead of shipping a silently
+        # wrong answer. None = NaN screening only (the residual costs
+        # one extra full-data HVP per solve).
+        self.residual_guard = (
+            None if residual_guard is None else float(residual_guard)
+        )
         self.mesh = mesh
 
         # flat layout derived from HOST copies before any cross-process
@@ -222,14 +233,18 @@ class FullInfluenceEngine:
             self._flat0, np.asarray(test_x), np.asarray(test_y)
         )
 
-    @partial(jax.jit, static_argnums=0)
-    def _solve(self, v, seed, flat0, train_x, train_y):
+    @partial(jax.jit, static_argnums=(0, 6))
+    def _solve(self, v, seed, flat0, train_x, train_y, solver):
+        # ``solver`` is an explicit static operand (NOT read off self):
+        # self is a static arg too, so a mutated self.solver would never
+        # retrace — the degradation ladder must be able to re-solve with
+        # the next rung and actually get it.
         hvp = lambda w: self._hvp_of(flat0, train_x, train_y, w)
-        if self.solver == "cg":
+        if solver == "cg":
             return solvers.solve_cg(
                 hvp, v, maxiter=self.cg_maxiter, tol=self.cg_tol
             )
-        elif self.solver == "lissa":
+        elif solver == "lissa":
             sample = (
                 self._lissa_sample_hvp(flat0, train_x, train_y,
                                        jax.random.PRNGKey(seed))
@@ -244,16 +259,51 @@ class FullInfluenceEngine:
                 sample_hvp=sample,
                 num_samples=self.lissa_samples if self.lissa_batch else 1,
             )
-        raise ValueError(f"unknown solver {self.solver!r}")
+        raise ValueError(f"unknown solver {solver!r}")
 
     def get_inverse_hvp(self, v, seed: int = 0):
-        return self._solve(jnp.asarray(v), np.uint32(seed), self._flat0,
-                           self.train_x, self.train_y)
+        """Solve H x = v, guarded against silent solver divergence.
+
+        The fetched solution is screened for non-finite values (the
+        LiSSA recursion "succeeds" into a NaN buffer when scale is
+        beaten by the spectrum) and — when ``residual_guard`` is set —
+        for relative residual above the guard. Either finding escalates
+        down the full-engine ladder (``lissa -> cg``; CG's best-iterate
+        freeze cannot diverge) and re-solves. Escalation is sticky: a
+        spectrum that beat LiSSA once will beat it again next call.
+        """
+        v = jnp.asarray(v)
+        solver = self.solver
+        while True:
+            x = self._solve(v, np.uint32(seed), self._flat0,
+                            self.train_x, self.train_y, solver)
+            # fault-injection site: corrupts the *screened* host copy,
+            # so recovery runs exactly as for a real diverged solve
+            xh = inject.corrupt("full.solve", np.asarray(self._fetch(x)))
+            bad = not np.isfinite(xh).all()
+            reason = "non-finite inverse-HVP"
+            if not bad and self.residual_guard is not None:
+                rr = self.relative_residual(v, x)
+                if not np.isfinite(rr) or rr > self.residual_guard:
+                    bad = True
+                    reason = (f"relative residual {rr:.3g} over guard "
+                              f"{self.residual_guard:g}")
+            if not bad:
+                return x
+            nxt = rpolicy.next_solver(solver, rpolicy.FULL_SOLVER_FALLBACK)
+            if nxt is None:
+                print(f"[reliability] {reason} from {solver!r} with no "
+                      "fallback rung left; returning as-is")
+                return x
+            print(f"[reliability] {reason} from {solver!r}; escalating "
+                  f"solver to {nxt!r}")
+            self.solver = solver = nxt
 
     @partial(jax.jit, static_argnums=0)
     def _residual_jit(self, v, x, flat0, train_x, train_y):
-        r = self._hvp_of(flat0, train_x, train_y, x) - v
-        return jnp.linalg.norm(r) / jnp.maximum(jnp.linalg.norm(v), 1e-30)
+        return solvers.relative_residual(
+            lambda w: self._hvp_of(flat0, train_x, train_y, w), v, x
+        )
 
     def relative_residual(self, v, x) -> float:
         """Relative residual ‖Hx − v‖/‖v‖ of a solve, at one extra HVP.

@@ -42,6 +42,22 @@ def solve_direct(H: jnp.ndarray, v: jnp.ndarray) -> jnp.ndarray:
     return jnp.linalg.solve(H, v)
 
 
+def relative_residual(
+    hvp: Callable[[jnp.ndarray], jnp.ndarray],
+    v: jnp.ndarray,
+    x: jnp.ndarray,
+) -> jnp.ndarray:
+    """Relative residual ‖Hx − v‖ / ‖v‖ of a candidate solve.
+
+    The one-number solve-quality statement shared by the reliability
+    divergence guards (engine NaN ladder, FullInfluenceEngine
+    ``residual_guard``) and the stress probes — costs a single extra
+    HVP. jit- and vmap-friendly; returns a 0-d array.
+    """
+    r = hvp(x) - v
+    return jnp.linalg.norm(r) / jnp.maximum(jnp.linalg.norm(v), 1e-30)
+
+
 def solve_cg(
     hvp: Callable[[jnp.ndarray], jnp.ndarray],
     v: jnp.ndarray,

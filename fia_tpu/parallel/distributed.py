@@ -37,6 +37,16 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from fia_tpu.reliability import inject, taxonomy
+from fia_tpu.reliability import policy as rpolicy
+
+# Per-array placement retry (see put_global): short delays — the engine's
+# _reset_device_state already waited out the worker-restart window, this
+# only covers the residual race at device_put time.
+_PUT_RETRY = rpolicy.RetryPolicy(
+    max_attempts=3, base_delay=0.1, max_delay=1.0, jitter=0.25
+)
+
 _initialized = False
 
 
@@ -265,11 +275,21 @@ def put_global(mesh: Mesh, tree, spec: P):
     local = not spans_processes(mesh)
 
     def put(x):
-        if local:
-            # device_put reshards on-device; forcing np.asarray here would
-            # round-trip already-device-resident params through the host.
-            return jax.device_put(x, sharding)
-        x = np.asarray(x)
-        return jax.make_array_from_callback(x.shape, sharding, lambda idx: x[idx])
+        def place():
+            inject.fire("distributed.put_global")
+            if local:
+                # device_put reshards on-device; forcing np.asarray here
+                # would round-trip already-device-resident params
+                # through the host.
+                return jax.device_put(x, sharding)
+            xa = np.asarray(x)
+            return jax.make_array_from_callback(
+                xa.shape, sharding, lambda idx: xa[idx]
+            )
+
+        # Placement races a restarting/preempted worker (the r4 k=256
+        # re-upload died at device_put); short bounded retries absorb
+        # the window, anything else surfaces untouched.
+        return _PUT_RETRY.run(place, retry_on=taxonomy.TRANSIENT)
 
     return jax.tree_util.tree_map(put, tree)

@@ -1,0 +1,31 @@
+"""Unified reliability layer.
+
+One home for everything the system does when hardware, runtimes, or
+numerics misbehave — previously scattered ad-hoc across
+``influence/engine.py`` (device-failure classification, retry-at-half),
+``utils/memlimits.py`` (OOM envelope persistence) and per-driver
+guesswork (no resume path at all — the round-5 measurement program lost
+6 of 8 chip-chain points to an interrupted run, VERDICT r5):
+
+- :mod:`~fia_tpu.reliability.taxonomy` — the single failure
+  classification (kernel faults, XLA/host OOM, ambiguous tunnel
+  failures, preemption, NaN payloads, deadline expiry). Every
+  ``except``-side decision in the repo keys off these kinds; no module
+  re-matches backend error strings on its own.
+- :mod:`~fia_tpu.reliability.policy` — composable recovery policies:
+  :class:`~fia_tpu.reliability.policy.RetryPolicy` (bounded exponential
+  backoff with deterministic jitter), :class:`~fia_tpu.reliability.
+  policy.Deadline`, and the solver degradation ladders
+  (``lissa → cg → direct``).
+- :mod:`~fia_tpu.reliability.inject` — a deterministic fault-injection
+  harness: scripted synthetic kernel faults / OOMs / NaN payloads at
+  named sites inside the engine, trainer and distributed layers, so
+  every recovery path is testable on CPU.
+- :mod:`~fia_tpu.reliability.journal` — a fingerprinted JSONL progress
+  journal powering resumable ``query_many`` streams and the RQ1 chain
+  (``python -m fia_tpu.cli.rq1 --resume``).
+
+See ``docs/reliability.md`` for the full design.
+"""
+
+from fia_tpu.reliability import inject, journal, policy, taxonomy  # noqa: F401

@@ -1,0 +1,190 @@
+"""Deterministic fault-injection harness.
+
+Every recovery path in the engine/trainer/distributed stack exists
+because a real TPU failure was observed once — but before this module,
+exercising those paths meant monkeypatching private engine methods per
+test. Now the production code itself carries named *injection sites*
+(:func:`fire` / :func:`corrupt` calls that are no-ops unless a plan is
+armed), and tests script synthetic failures against them:
+
+    from fia_tpu.reliability import inject
+
+    plan = [inject.Fault("engine.dispatch_flat", at=0, kind="worker"),
+            inject.Fault("engine.solve", at=1, kind="nan")]
+    with inject.active(*plan):
+        engine.query_many(pts)          # recovery paths actually run
+
+Faults fire on exact per-site call indices (``at``), so a schedule is
+fully deterministic: the same plan against the same workload exercises
+the same recovery decisions every run, on CPU, with no hardware in the
+loop. Synthetic exception messages reuse the *observed* production
+signatures (the r3/r4 worker-death and tunnel-500 strings), so the
+taxonomy classifies injected faults exactly like real ones — the test
+never talks to the classifier directly.
+
+Sites currently wired (grep for ``inject.fire``/``inject.corrupt``):
+
+==========================  ================================================
+``engine.upload``           device-state (re)build in ``_upload_device_state``
+``engine.dispatch_flat``    flat-path query dispatch
+``engine.dispatch_padded``  padded-path query dispatch
+``engine.solve``            fetched iHVP payload (``kind="nan"`` corrupts)
+``full.solve``              FullInfluenceEngine fetched solve (``kind="nan"``)
+``trainer.epoch``           one compiled-epoch dispatch in ``Trainer.fit``
+``trainer.loo_segment``     one LOO retraining segment dispatch
+``distributed.put_global``  global-array placement
+==========================  ================================================
+
+Thread-safety: the armed plan is process-global module state (like a
+real fault domain); arm it from the test thread only.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from fia_tpu.reliability import taxonomy
+
+# Observed production signatures (BASELINE §4.1, engine.py history) —
+# injected faults must classify identically to the real thing.
+MESSAGES = {
+    taxonomy.OOM: (
+        "RESOURCE_EXHAUSTED: XLA:TPU compile permanent error. "
+        "Ran out of memory in memory space hbm (injected)"
+    ),
+    taxonomy.AMBIGUOUS: (
+        "HTTP 500: tpu_compile_helper subprocess exit code 1 (injected)"
+    ),
+    taxonomy.WORKER: (
+        "UNAVAILABLE: TPU worker process crashed or restarted "
+        "(kernel fault, injected)"
+    ),
+    taxonomy.PREEMPTION: (
+        "ABORTED: The TPU worker was preempted by a maintenance event "
+        "(injected)"
+    ),
+}
+
+
+@dataclass
+class Fault:
+    """One scheduled synthetic fault.
+
+    ``site``: injection-site name (see module docstring).
+    ``at``: 0-based call index at that site (the N-th ``fire``/
+    ``corrupt`` there).
+    ``kind``: a taxonomy kind — ``oom`` / ``ambiguous`` / ``worker`` /
+    ``preemption`` raise a RuntimeError carrying the observed signature,
+    ``host_oom`` raises :class:`MemoryError`, ``nan`` corrupts the
+    payload passed through :func:`corrupt` (it never raises).
+    ``message``: optional signature override.
+    """
+
+    site: str
+    at: int
+    kind: str
+    message: str | None = None
+    fired: bool = field(default=False, compare=False)
+
+
+class Injector:
+    """Counts calls per site and fires the scheduled faults."""
+
+    def __init__(self, faults):
+        self.faults = list(faults)
+        self.counts: dict[str, int] = {}
+        self.log: list[tuple[str, int, str]] = []
+
+    def _tick(self, site: str) -> int:
+        idx = self.counts.get(site, 0)
+        self.counts[site] = idx + 1
+        return idx
+
+    def _match(self, site: str, idx: int, *, nan: bool):
+        for f in self.faults:
+            if (
+                f.site == site
+                and f.at == idx
+                and (f.kind == taxonomy.NAN) == nan
+                and not f.fired
+            ):
+                return f
+        return None
+
+    def fire(self, site: str) -> None:
+        idx = self._tick(site)
+        f = self._match(site, idx, nan=False)
+        if f is None:
+            return
+        f.fired = True
+        self.log.append((site, idx, f.kind))
+        if f.kind == taxonomy.HOST_OOM:
+            raise MemoryError(f.message or "injected host allocation failure")
+        msg = f.message or MESSAGES.get(f.kind)
+        if msg is None:
+            raise ValueError(f"no synthetic signature for kind {f.kind!r}")
+        raise RuntimeError(msg)
+
+    def corrupt(self, site: str, array):
+        idx = self._tick(site)
+        f = self._match(site, idx, nan=True)
+        if f is None:
+            return array
+        f.fired = True
+        self.log.append((site, idx, f.kind))
+        out = np.array(array, copy=True)
+        if out.size:
+            out.reshape(-1)[0] = np.nan
+        return out
+
+    def unfired(self) -> list[Fault]:
+        return [f for f in self.faults if not f.fired]
+
+
+_active: Injector | None = None
+
+
+def fire(site: str) -> None:
+    """Injection site: raises the scheduled synthetic failure, if any.
+    A no-op (one global read) when no plan is armed."""
+    if _active is not None:
+        _active.fire(site)
+
+
+def corrupt(site: str, array):
+    """Payload injection site: returns ``array`` with NaN written into
+    its first element when a ``nan`` fault is scheduled here, else the
+    array untouched."""
+    if _active is not None:
+        return _active.corrupt(site, array)
+    return array
+
+
+def call_count(site: str) -> int:
+    """How many times ``site`` has been reached under the armed plan
+    (0 when no plan is armed) — tests assert recovery-path shapes."""
+    if _active is None:
+        return 0
+    return _active.counts.get(site, 0)
+
+
+@contextmanager
+def active(*faults: Fault):
+    """Arm a fault plan for the duration of the block.
+
+    Yields the :class:`Injector` so tests can inspect ``log``/
+    ``counts``/``unfired`` afterwards. Nesting is rejected — overlapping
+    plans would make schedules ambiguous.
+    """
+    global _active
+    if _active is not None:
+        raise RuntimeError("a fault-injection plan is already armed")
+    inj = Injector(faults)
+    _active = inj
+    try:
+        yield inj
+    finally:
+        _active = None

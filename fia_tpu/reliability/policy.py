@@ -1,0 +1,156 @@
+"""Composable recovery policies.
+
+Three building blocks, shared by the engine, trainer, distributed
+runtime and CLI drivers:
+
+- :class:`RetryPolicy` — bounded exponential backoff with
+  *deterministic* jitter. Determinism matters twice: recovery paths
+  replay bit-identically under the fault-injection harness (the CPU
+  test suite asserts on exact retry schedules), and a fleet of
+  same-seeded processes still de-synchronises because the jitter hash
+  folds in the per-policy seed.
+- :class:`Deadline` — a monotonic time budget that composes with
+  retries (a retry whose backoff would overshoot the deadline surfaces
+  the original failure instead of sleeping through it) and with the
+  journaled drivers (expiry is a clean, resumable stop — kind
+  ``DEADLINE`` — not an error).
+- the solver degradation ladders — ``next_solver`` encodes the
+  fallback order for diverging/NaN iHVP solves: ``lissa → cg →
+  direct`` for the block engine (``schulz`` falls back to ``direct``
+  too), ``lissa → cg`` for the full-parameter engine where the block
+  Hessian cannot be materialised.
+"""
+
+from __future__ import annotations
+
+import time as _time
+from dataclasses import dataclass
+from typing import Callable, Iterable
+
+from fia_tpu.reliability import taxonomy
+
+
+def _mix64(*vals: int) -> int:
+    """Deterministic 64-bit hash (splitmix64 over folded inputs)."""
+    h = 0x9E3779B97F4A7C15
+    for v in vals:
+        h = (h ^ (v & 0xFFFFFFFFFFFFFFFF)) * 0xBF58476D1CE4E5B9 % (1 << 64)
+        h = (h ^ (h >> 27)) * 0x94D049BB133111EB % (1 << 64)
+        h ^= h >> 31
+    return h
+
+
+class Deadline:
+    """A wall-clock budget on a unit of work.
+
+    ``seconds=None`` (or <= 0) is the unbounded deadline — every check
+    passes — so call sites can thread one object unconditionally.
+    """
+
+    def __init__(self, seconds: float | None = None):
+        self.seconds = None if not seconds or seconds <= 0 else float(seconds)
+        self._t0 = _time.monotonic()
+
+    def remaining(self) -> float:
+        if self.seconds is None:
+            return float("inf")
+        return self.seconds - (_time.monotonic() - self._t0)
+
+    def expired(self) -> bool:
+        return self.remaining() <= 0.0
+
+    def check(self, what: str = "work") -> None:
+        """Raise :class:`~fia_tpu.reliability.taxonomy.DeadlineExpired`
+        when the budget is spent."""
+        if self.expired():
+            raise taxonomy.DeadlineExpired(
+                f"deadline of {self.seconds:.3f}s expired during {what}"
+            )
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded exponential backoff with deterministic jitter.
+
+    ``delay(attempt)`` for attempt 0, 1, 2, … is
+    ``min(base_delay * multiplier**attempt, max_delay)`` scaled by a
+    deterministic jitter factor in ``[1 - jitter, 1 + jitter]`` derived
+    from ``(seed, attempt)`` — the same policy always produces the same
+    schedule (replayable under fault injection), while different seeds
+    de-synchronise concurrent processes.
+    """
+
+    max_attempts: int = 4
+    base_delay: float = 0.5
+    max_delay: float = 30.0
+    multiplier: float = 2.0
+    jitter: float = 0.25
+    seed: int = 0
+
+    def delay(self, attempt: int) -> float:
+        raw = min(
+            self.base_delay * (self.multiplier ** attempt), self.max_delay
+        )
+        if self.jitter <= 0.0 or raw <= 0.0:
+            return raw
+        frac = (_mix64(self.seed, attempt) % (1 << 24)) / float(1 << 24)
+        return raw * (1.0 + self.jitter * (2.0 * frac - 1.0))
+
+    def delays(self) -> list[float]:
+        """The full backoff schedule (between-attempt sleeps)."""
+        return [self.delay(i) for i in range(max(self.max_attempts - 1, 0))]
+
+    def run(
+        self,
+        fn: Callable,
+        *,
+        retry_on: Iterable[str] = taxonomy.TRANSIENT,
+        classify: Callable[[BaseException], str | None] = taxonomy.classify,
+        deadline: Deadline | None = None,
+        sleep: Callable[[float], None] = _time.sleep,
+        on_retry: Callable[[str, int, BaseException], None] | None = None,
+    ):
+        """Call ``fn`` with bounded retries on classified-transient
+        failures.
+
+        Unclassified failures and kinds outside ``retry_on`` surface
+        immediately; so does a failure whose next backoff would
+        overshoot ``deadline`` (sleeping past a budget only delays the
+        inevitable surfacing). ``on_retry(kind, attempt, exc)`` runs
+        before each backoff — recovery hooks (device-state rebuilds)
+        and logging go there.
+        """
+        retry_on = frozenset(retry_on)
+        attempts = max(int(self.max_attempts), 1)
+        for attempt in range(attempts):
+            try:
+                return fn()
+            except Exception as e:
+                kind = classify(e)
+                if kind not in retry_on or attempt + 1 >= attempts:
+                    raise
+                d = self.delay(attempt)
+                if deadline is not None and deadline.remaining() < d:
+                    raise
+                if on_retry is not None:
+                    on_retry(kind, attempt, e)
+                if d > 0.0:
+                    sleep(d)
+
+
+# Solver degradation ladders (``Revisiting inverse Hessian vector
+# products`` motivates treating iHVP divergence as a first-class
+# failure: it is a silent-wrong-answer class, not a crash). The block
+# engine can always fall back to materialising the tiny block Hessian
+# and LU-solving it exactly; the full-parameter engine cannot, so its
+# ladder ends at CG (whose best-iterate freeze never diverges).
+QUERY_SOLVER_FALLBACK = {"lissa": "cg", "schulz": "direct", "cg": "direct"}
+FULL_SOLVER_FALLBACK = {"lissa": "cg"}
+
+
+def next_solver(
+    current: str, fallback: dict[str, str] = QUERY_SOLVER_FALLBACK
+) -> str | None:
+    """The next (more robust) rung under ``current``, or ``None`` at
+    the ladder's bottom."""
+    return fallback.get(current)

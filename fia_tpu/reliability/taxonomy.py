@@ -1,0 +1,152 @@
+"""The unified failure taxonomy.
+
+Every recovery decision in the repo — retry vs halve vs rebuild vs
+surface — starts from one question: *what kind of failure was that?*
+This module is the single answer. The device-failure classifier grew up
+inside ``influence/engine.py`` (r3/r4, see the per-kind notes below);
+it lives here now so the trainer, the distributed runtime and the CLI
+drivers share exactly the same signatures instead of re-matching
+backend strings ad hoc.
+
+Kinds (the ``FaultKind`` constants):
+
+- ``OOM`` — the backend said so explicitly (``RESOURCE_EXHAUSTED`` /
+  "Ran out of memory"): definite evidence, safe to persist in the
+  cross-process memory envelope (``utils/memlimits.py``).
+- ``HOST_OOM`` — a Python-side :class:`MemoryError` (host RAM, not
+  HBM): halving device dispatches won't help; callers shed host-side
+  buffers (smaller windows, packed views) instead. Never persisted to
+  the device envelope.
+- ``AMBIGUOUS`` — tunnel-attached TPUs (axon remote compile) wrap the
+  XLA error in a generic "HTTP 500: tpu_compile_helper subprocess exit
+  code N" whose OOM detail only reaches stderr. Could be OOM (observed:
+  256-query NCF batch at pad 4608, 16.06G of 15.75G HBM) or a transient
+  tunnel fault: retried ONCE at the same size before halving, and never
+  persisted cross-process — one flaky HTTP 500 must not poison the
+  shared envelope for every later process (r3 advisor finding).
+- ``WORKER`` — the TPU worker process died at RUNTIME (r3 k=256: the
+  (chunk, 514, 514) accumulation buffer reached 2.2 GB and killed the
+  worker, not an XLA OOM). Every device buffer the client held is gone;
+  recovery needs a device-state rebuild plus a smaller dispatch.
+- ``PREEMPTION`` — the platform reclaimed the worker (maintenance
+  event / preemptible capacity). Same recovery shape as ``WORKER``
+  (buffers gone, worker returns later), but it carries no size
+  evidence at all: never halve on preemption, just back off, rebuild
+  and retry at the same size.
+- ``NAN`` — a solver or gradient produced non-finite payloads. This is
+  the *silent-wrong-answer* class ("Revisiting iHVPs", PAPERS.md): the
+  dispatch "succeeded", so no exception reaches us from the backend —
+  classification happens on the fetched host arrays
+  (:func:`classify_payload`) and recovery is the solver degradation
+  ladder (``policy.next_solver``), not a retry.
+- ``DEADLINE`` — a :class:`~fia_tpu.reliability.policy.Deadline`
+  expired. Not an error in the work itself: journaled callers stop
+  cleanly and resume later.
+
+``classify`` returns ``None`` for anything unrecognised — callers must
+re-raise those; an unknown failure retried blindly is how wrong answers
+ship.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class FaultKind:
+    """String constants for the failure kinds (stable public names)."""
+
+    OOM = "oom"
+    HOST_OOM = "host_oom"
+    AMBIGUOUS = "ambiguous"
+    WORKER = "worker"
+    PREEMPTION = "preemption"
+    NAN = "nan"
+    DEADLINE = "deadline"
+
+
+OOM = FaultKind.OOM
+HOST_OOM = FaultKind.HOST_OOM
+AMBIGUOUS = FaultKind.AMBIGUOUS
+WORKER = FaultKind.WORKER
+PREEMPTION = FaultKind.PREEMPTION
+NAN = FaultKind.NAN
+DEADLINE = FaultKind.DEADLINE
+
+# Kinds whose recovery destroys no information: the same dispatch may
+# legitimately be retried (after a state rebuild for WORKER/PREEMPTION).
+TRANSIENT = frozenset({WORKER, PREEMPTION, AMBIGUOUS})
+
+# Kinds that say "this dispatch was too big": halving is the right move.
+SIZE_EVIDENCE = frozenset({OOM, AMBIGUOUS, WORKER})
+
+
+class DeadlineExpired(TimeoutError):
+    """A reliability Deadline ran out (classified as ``DEADLINE``)."""
+
+
+class NanPayload(FloatingPointError):
+    """Non-finite values detected in a fetched result payload
+    (classified as ``NAN``)."""
+
+
+def classify(e: BaseException) -> str | None:
+    """Classify a failure for the retry/degradation layers.
+
+    Exception *types* are checked first (our own deadline/NaN markers,
+    host :class:`MemoryError`), then the backend message signatures in
+    evidence order: definite OOM, preemption, ambiguous tunnel wrap,
+    worker death. Returns ``None`` for anything unrecognised — callers
+    must re-raise those.
+    """
+    if isinstance(e, DeadlineExpired):
+        return DEADLINE
+    if isinstance(e, NanPayload):
+        return NAN
+    if isinstance(e, MemoryError):
+        return HOST_OOM
+    s = str(e)
+    if "RESOURCE_EXHAUSTED" in s or "out of memory" in s.lower():
+        return OOM
+    if "preempt" in s.lower() or "maintenance event" in s.lower():
+        # TPU preemption surfaces as ABORTED/UNAVAILABLE "... worker
+        # preempted" (or a maintenance-event notice); checked before the
+        # worker signatures because the messages often co-mention the
+        # worker, and preemption must NOT trigger retry-at-half — it
+        # carries no size evidence.
+        return PREEMPTION
+    if "tpu_compile_helper subprocess exit code" in s:
+        return AMBIGUOUS
+    if (
+        "worker process crashed or restarted" in s
+        or "kernel fault" in s
+        or ("UNAVAILABLE" in s and "TPU worker" in s)
+        # the r4 k=256 crash's terse runtime form ("INTERNAL: TPU
+        # backend error (Internal)."); compile/lowering internals that
+        # happen to share the phrase must NOT trigger retry-at-half
+        # cascades — each halved shape is a fresh 40-66 s compile that
+        # would fail identically
+        or (
+            "TPU backend error" in s
+            and not any(k in s for k in ("compile", "lower", "Mosaic"))
+        )
+    ):
+        return WORKER
+    return None
+
+
+def classify_payload(*arrays) -> str | None:
+    """``NAN`` when any array holds a non-finite value, else ``None``.
+
+    The NaN class never raises out of the backend — a diverged LiSSA
+    recursion returns a "successful" buffer full of NaNs — so payload
+    classification runs on the fetched host arrays. ``None`` entries
+    are skipped (lazy result fields).
+    """
+    for a in arrays:
+        if a is None:
+            continue
+        a = np.asarray(a)
+        if a.dtype.kind == "f" and not np.isfinite(a).all():
+            return NAN
+    return None

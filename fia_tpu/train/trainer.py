@@ -22,6 +22,17 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from fia_tpu.reliability import inject, taxonomy
+from fia_tpu.reliability import policy as rpolicy
+
+# Transient device failures (worker crash/restart, preemption, tunnel
+# HTTP-500) during a training dispatch retry on this schedule; the
+# functional step API (params in, params out) makes the retried call
+# idempotent. Unclassified failures surface immediately.
+_TRAIN_RETRY = rpolicy.RetryPolicy(
+    max_attempts=3, base_delay=2.0, max_delay=30.0, jitter=0.25
+)
+
 
 @dataclass
 class TrainConfig:
@@ -49,9 +60,11 @@ class Trainer:
     exact-divisor sizes, 3020/3009) are padded with zero-weight
     positions, which the weighted-mean loss ignores exactly."""
 
-    def __init__(self, model, config: TrainConfig, event_log=None, mesh=None):
+    def __init__(self, model, config: TrainConfig, event_log=None, mesh=None,
+                 retry_policy: "rpolicy.RetryPolicy | None" = None):
         self.model = model
         self.config = config
+        self.retry_policy = _TRAIN_RETRY if retry_policy is None else retry_policy
         self.optimizer = optax.adam(config.learning_rate)
         self.sgd = optax.sgd(config.learning_rate * 10.0)
         self.event_log = event_log  # utils.logging.EventLog or None
@@ -214,9 +227,19 @@ class Trainer:
             r = abs_step % nb
             todo = min(nb - r, mini_steps - done)
             ekey = jax.random.fold_in(key, epoch_i)
-            params, opt_state, losses = epoch_fn(
-                params, opt_state, x, y, w, ekey,
-                jnp.int32(r), jnp.int32(r + todo),
+
+            def dispatch_epoch(params=params, opt_state=opt_state,
+                               ekey=ekey, r=r, todo=todo):
+                inject.fire("trainer.epoch")
+                return epoch_fn(
+                    params, opt_state, x, y, w, ekey,
+                    jnp.int32(r), jnp.int32(r + todo),
+                )
+
+            # functional inputs are reused verbatim on retry, so a
+            # transient worker death replays this epoch segment exactly
+            params, opt_state, losses = self.retry_policy.run(
+                dispatch_epoch, retry_on=taxonomy.TRANSIENT
             )
             done += todo
             if cfg.log_every and ((epoch_i + 1) % max(1, cfg.log_every // nb) == 0):
@@ -346,6 +369,7 @@ def loo_retrain_many(
     seeds=None,
     steps_per_dispatch: int = 2000,
     mesh=None,
+    retry_policy: "rpolicy.RetryPolicy | None" = None,
 ):
     """Leave-one-out retraining, vmapped over removed points.
 
@@ -426,10 +450,25 @@ def loo_retrain_many(
         x, y = place(x, rep), place(y, rep)
     # the ragged tail scans only the remaining epochs (one extra compile)
     # rather than a padded segment of masked no-op steps
+    pol = _TRAIN_RETRY if retry_policy is None else retry_policy
     for start in range(0, n_epochs, seg_epochs):
         seg = keys[:, start : start + seg_epochs]
-        params, opt_state, t = adv(params, opt_state, t, removed, seg, x, y)
-        jax.block_until_ready(t)
+
+        def dispatch_seg(params=params, opt_state=opt_state, t=t, seg=seg):
+            inject.fire("trainer.loo_segment")
+            out = adv(params, opt_state, t, removed, seg, x, y)
+            jax.block_until_ready(out[2])
+            return out
+
+        # Retry caveat: adv donates its lane stacks, so a failure AFTER
+        # the dispatch enters XLA may leave them deleted and the retry
+        # surfaces that instead — which is correct behavior for this
+        # segment-chained program (replaying from deleted inputs cannot
+        # give the right answer; the caller restarts the chain). Faults
+        # at the dispatch boundary (the observed tunnel/worker class,
+        # and everything the injection harness schedules) retry cleanly.
+        params, opt_state, t = pol.run(dispatch_seg,
+                                       retry_on=taxonomy.TRANSIENT)
     return (
         params
         if R == R_real

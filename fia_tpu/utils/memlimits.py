@@ -27,7 +27,13 @@ import tempfile
 _ENV = "FIA_MEMLIMIT_CACHE"
 _DEFAULT = os.path.join("output", ".mem_limits.json")
 
-_UNSET_BAD = 1 << 62
+# Sentinel for "no failing size on record". Public as UNSET_BAD so the
+# engine and the reliability layer compare against one shared constant
+# instead of re-spelling the literal (the taxonomy's SIZE_EVIDENCE kinds
+# are the only ones allowed to lower it — see
+# fia_tpu/reliability/taxonomy.py).
+UNSET_BAD = 1 << 62
+_UNSET_BAD = UNSET_BAD  # backward-compatible private alias
 
 
 def _path() -> str:

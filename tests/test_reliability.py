@@ -1,0 +1,582 @@
+"""The reliability layer (fia_tpu/reliability): taxonomy, deterministic
+backoff, fault injection driving the engine/trainer degradation ladders,
+and journal-backed resumable execution.
+
+Recovery assertions are exact where the re-dispatch reuses the same
+program shape (same-size retries, journal replay: bit-identical) and
+tolerance-based where recovery legitimately changes accumulation order
+(halved batches, CPU-backend rung, solver escalation — the repo's
+established rtol=1e-4/atol=1e-6 convention, test_influence.py).
+"""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from fia_tpu.data.dataset import RatingDataset
+from fia_tpu.influence.engine import InfluenceEngine
+from fia_tpu.models import MF
+from fia_tpu.reliability import inject, taxonomy
+from fia_tpu.reliability import policy as rpolicy
+from fia_tpu.reliability.journal import Journal, JournalMismatch, pack, unpack
+from fia_tpu.train.trainer import Trainer, TrainConfig
+
+U, I, K = 30, 20, 4
+WD = 1e-2
+DAMP = 1e-3
+
+# no-sleep policy for tests that exercise retry logic, not backoff
+FAST = rpolicy.RetryPolicy(max_attempts=3, base_delay=0.0, jitter=0.0)
+
+
+def _setup(seed=0, n=400):
+    rng = np.random.default_rng(seed)
+    x = np.stack(
+        [rng.integers(0, U, n), rng.integers(0, I, n)], axis=1
+    ).astype(np.int32)
+    y = rng.integers(1, 6, n).astype(np.float32)
+    train = RatingDataset(x, y)
+    model = MF(U, I, K, WD)
+    params = model.init_params(jax.random.PRNGKey(seed))
+    return model, params, train
+
+
+class TestTaxonomy:
+    def test_signature_strings_classify(self):
+        cases = {
+            "RESOURCE_EXHAUSTED: Ran out of memory in memory space hbm":
+                taxonomy.OOM,
+            "XLA:TPU ran out of memory while allocating": taxonomy.OOM,
+            "HTTP 500: tpu_compile_helper subprocess exit code 1":
+                taxonomy.AMBIGUOUS,
+            "UNAVAILABLE: TPU worker process crashed or restarted":
+                taxonomy.WORKER,
+            "INTERNAL: TPU backend error (Internal).": taxonomy.WORKER,
+            "ABORTED: The TPU worker was preempted by a maintenance "
+            "event": taxonomy.PREEMPTION,
+        }
+        for msg, kind in cases.items():
+            assert taxonomy.classify(RuntimeError(msg)) == kind, msg
+
+    def test_preemption_wins_over_worker_signatures(self):
+        # a preempted worker's message often ALSO matches the worker
+        # signatures; preemption carries no size evidence and must win
+        # (halving on it would shrink batches for no reason)
+        e = RuntimeError(
+            "UNAVAILABLE: TPU worker process crashed or restarted: "
+            "the node was preempted"
+        )
+        assert taxonomy.classify(e) == taxonomy.PREEMPTION
+        assert taxonomy.PREEMPTION not in taxonomy.SIZE_EVIDENCE
+
+    def test_compile_phase_and_ordinary_errors_unclassified(self):
+        assert taxonomy.classify(RuntimeError(
+            "INTERNAL: TPU backend error: Mosaic lowering failed"
+        )) is None
+        assert taxonomy.classify(ValueError("shape mismatch")) is None
+
+    def test_exception_types_classify(self):
+        assert taxonomy.classify(
+            taxonomy.DeadlineExpired("t")) == taxonomy.DEADLINE
+        assert taxonomy.classify(taxonomy.NanPayload("n")) == taxonomy.NAN
+        assert taxonomy.classify(MemoryError("m")) == taxonomy.HOST_OOM
+
+    def test_classify_payload(self):
+        clean = np.ones(4, np.float32)
+        bad = clean.copy()
+        bad[2] = np.nan
+        assert taxonomy.classify_payload(clean, None) is None
+        assert taxonomy.classify_payload(clean, bad) == taxonomy.NAN
+        assert taxonomy.classify_payload(
+            np.full(3, np.inf, np.float64)) == taxonomy.NAN
+
+
+class TestPolicy:
+    def test_backoff_deterministic_and_bounded(self):
+        p = rpolicy.RetryPolicy(max_attempts=6, base_delay=0.5,
+                                max_delay=4.0, jitter=0.25, seed=7)
+        assert p.delays() == p.delays()  # replayable schedule
+        for i, d in enumerate(p.delays()):
+            raw = min(0.5 * 2.0 ** i, 4.0)
+            assert raw * 0.75 <= d <= raw * 1.25
+        # different seeds de-synchronise a same-config fleet
+        q = rpolicy.RetryPolicy(max_attempts=6, base_delay=0.5,
+                                max_delay=4.0, jitter=0.25, seed=8)
+        assert p.delays() != q.delays()
+
+    def test_run_retries_transient_then_succeeds(self):
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise RuntimeError(inject.MESSAGES[taxonomy.WORKER])
+            return "ok"
+
+        assert FAST.run(flaky) == "ok"
+        assert len(calls) == 3
+
+    def test_run_surfaces_non_retryable_immediately(self):
+        calls = []
+
+        def broken():
+            calls.append(1)
+            raise ValueError("not transient")
+
+        with pytest.raises(ValueError):
+            FAST.run(broken)
+        assert len(calls) == 1
+
+    def test_run_exhausts_attempts(self):
+        calls = []
+
+        def always():
+            calls.append(1)
+            raise RuntimeError(inject.MESSAGES[taxonomy.WORKER])
+
+        with pytest.raises(RuntimeError):
+            FAST.run(always)
+        assert len(calls) == FAST.max_attempts
+
+    def test_run_refuses_to_sleep_past_deadline(self):
+        slow = rpolicy.RetryPolicy(max_attempts=4, base_delay=100.0,
+                                   jitter=0.0)
+        calls = []
+
+        def always():
+            calls.append(1)
+            raise RuntimeError(inject.MESSAGES[taxonomy.WORKER])
+
+        with pytest.raises(RuntimeError):
+            slow.run(always, deadline=rpolicy.Deadline(0.5))
+        assert len(calls) == 1  # surfaced instead of a 100 s sleep
+
+    def test_deadline(self):
+        assert not rpolicy.Deadline(None).expired()
+        assert rpolicy.Deadline(0.0).remaining() == float("inf")
+        d = rpolicy.Deadline(1e-9)
+        assert d.expired()
+        with pytest.raises(taxonomy.DeadlineExpired):
+            d.check("unit test")
+
+    def test_solver_ladders(self):
+        assert rpolicy.next_solver("lissa") == "cg"
+        assert rpolicy.next_solver("cg") == "direct"
+        assert rpolicy.next_solver("schulz") == "direct"
+        assert rpolicy.next_solver("direct") is None
+        assert rpolicy.next_solver(
+            "lissa", rpolicy.FULL_SOLVER_FALLBACK) == "cg"
+        assert rpolicy.next_solver(
+            "cg", rpolicy.FULL_SOLVER_FALLBACK) is None
+
+
+class TestInjector:
+    def test_fires_at_exact_call_index(self):
+        with inject.active(
+            inject.Fault("site.a", at=1, kind=taxonomy.WORKER)
+        ) as inj:
+            inject.fire("site.a")  # idx 0: passes
+            with pytest.raises(RuntimeError) as ei:
+                inject.fire("site.a")  # idx 1: fires
+            inject.fire("site.a")  # idx 2: fault already consumed
+            assert taxonomy.classify(ei.value) == taxonomy.WORKER
+        assert inj.counts == {"site.a": 3}
+        assert inj.unfired() == []
+        assert inject.call_count("site.a") == 0  # disarmed
+
+    def test_all_synthetic_signatures_classify_like_production(self):
+        for kind in (taxonomy.OOM, taxonomy.AMBIGUOUS, taxonomy.WORKER,
+                     taxonomy.PREEMPTION):
+            with inject.active(inject.Fault("s", at=0, kind=kind)):
+                with pytest.raises(RuntimeError) as ei:
+                    inject.fire("s")
+            assert taxonomy.classify(ei.value) == kind
+        with inject.active(
+            inject.Fault("s", at=0, kind=taxonomy.HOST_OOM)
+        ):
+            with pytest.raises(MemoryError):
+                inject.fire("s")
+
+    def test_corrupt_writes_nan_without_touching_input(self):
+        arr = np.arange(4.0, dtype=np.float32)
+        with inject.active(inject.Fault("s", at=0, kind=taxonomy.NAN)):
+            out = inject.corrupt("s", arr)
+            again = inject.corrupt("s", arr)  # idx 1: untouched
+        assert np.isnan(out[0]) and np.isfinite(out[1:]).all()
+        assert np.isfinite(arr).all()  # input never mutated
+        assert again is arr
+
+    def test_nesting_rejected(self):
+        with inject.active():
+            with pytest.raises(RuntimeError, match="already armed"):
+                with inject.active():
+                    pass
+
+
+class TestJournal:
+    FP = {"kind": "test", "n": 3}
+
+    def test_exact_array_and_float_round_trip(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        payload = {
+            "f32": np.float32(np.pi) * np.arange(5, dtype=np.float32),
+            "f64": np.asarray([0.1, 1.0 / 3.0, 1e-300]),
+            "i64": np.asarray([-1, 1 << 60]),
+            "scalar": float(np.float32(2.0) / 3.0),
+        }
+        with Journal.open(path, self.FP, fsync=False) as j:
+            j.record("u:0", payload)
+        with Journal.open(path, self.FP, resume=True, fsync=False) as j2:
+            assert j2.done("u:0") and not j2.done("u:1")
+            got = j2.get("u:0")
+        for k in ("f32", "f64", "i64"):
+            assert got[k].dtype == payload[k].dtype
+            np.testing.assert_array_equal(got[k], payload[k])
+        assert got["scalar"] == payload["scalar"]
+
+    def test_pack_unpack_inverse(self):
+        obj = {"a": [np.float32(1.5), {"b": np.arange(3)}], "c": None}
+        rt = unpack(pack(obj))
+        assert rt["a"][0] == 1.5 and rt["c"] is None
+        np.testing.assert_array_equal(rt["a"][1]["b"], np.arange(3))
+
+    def test_non_resume_rotates_stale(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        with Journal.open(path, self.FP, fsync=False) as j:
+            j.record("u:0", {"x": 1})
+        with Journal.open(path, self.FP, resume=False, fsync=False) as j2:
+            assert not j2.done("u:0")  # fresh run inherits nothing
+        assert os.path.exists(path + ".stale")
+
+    def test_fingerprint_mismatch_fails_loudly(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        Journal.open(path, self.FP, fsync=False).close()
+        with pytest.raises(JournalMismatch):
+            Journal.open(path, {"kind": "test", "n": 4}, resume=True,
+                         fsync=False)
+
+    def test_truncated_tail_dropped_not_fatal(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        with Journal.open(path, self.FP, fsync=False) as j:
+            j.record("u:0", {"x": np.arange(3)})
+            j.record("u:1", {"x": np.arange(4)})
+        with open(path, "a") as fh:
+            fh.write('{"kind": "done", "key": "u:2", "payl')  # kill mid-append
+        with Journal.open(path, self.FP, resume=True, fsync=False) as j2:
+            assert j2.done("u:0") and j2.done("u:1") and not j2.done("u:2")
+            assert j2.corrupt_lines == 1
+
+    def test_headerless_file_rotated_fresh(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        with open(path, "w") as fh:
+            fh.write("not a journal at all\n")
+        with Journal.open(path, self.FP, resume=True, fsync=False) as j:
+            assert not j.entries
+        assert os.path.exists(path + ".stale")
+
+
+class TestEngineRecovery:
+    """Injected faults on CPU drive the real degradation ladders; the
+    recovered scores must match a fault-free run (ISSUE acceptance)."""
+
+    def _engine(self, **kw):
+        model, params, train = _setup()
+        kw.setdefault("damping", DAMP)
+        kw.setdefault("impl", "flat")
+        return InfluenceEngine(model, params, train, **kw), train
+
+    def test_worker_fault_in_query_many_bit_identical(self):
+        eng, train = self._engine()
+        pts = np.asarray(train.x[:4])
+        base = eng.query_many(pts, batch_queries=2)
+        fresh, _ = self._engine()
+        with inject.active(
+            inject.Fault("engine.dispatch_flat", at=1,
+                         kind=taxonomy.WORKER)
+        ) as inj:
+            got = fresh.query_many(pts, batch_queries=2)
+        assert inj.unfired() == []
+        # crash killed both in-flight batches; sequential same-size
+        # re-dispatch reruns both (2 pipelined + 2 recovery)
+        assert inj.counts["engine.dispatch_flat"] == 4
+        assert len(got) == len(base)
+        for g, b in zip(got, base):
+            np.testing.assert_array_equal(g.counts, b.counts)
+            for t in range(len(g.counts)):
+                # same program, same shapes -> bit-identical recovery
+                np.testing.assert_array_equal(g.scores_of(t),
+                                              b.scores_of(t))
+
+    def test_preemption_retries_same_size(self):
+        eng, train = self._engine()
+        pts = np.asarray(train.x[:4])
+        base = eng.query_batch(pts)
+        fresh, _ = self._engine()
+        with inject.active(
+            inject.Fault("engine.dispatch_flat", at=0,
+                         kind=taxonomy.PREEMPTION)
+        ) as inj:
+            got = fresh.query_batch(pts)
+        # no halving: one failed full-size dispatch, one retried
+        assert inj.counts["engine.dispatch_flat"] == 2
+        assert inj.counts["engine.upload"] == 1  # state was rebuilt
+        for t in range(len(pts)):
+            np.testing.assert_array_equal(got.scores_of(t),
+                                          base.scores_of(t))
+
+    def test_oom_degrades_to_cpu_backend_rung(self):
+        eng, train = self._engine()
+        pts = np.asarray(train.x[:4])
+        base = eng.query_batch(pts)
+        fresh, _ = self._engine()
+        with inject.active(
+            inject.Fault("engine.dispatch_flat", at=0, kind=taxonomy.OOM)
+        ):
+            got = fresh.query_batch(pts)
+        assert fresh._cpu_engine is not None  # last rung actually ran
+        for t in range(len(pts)):
+            # the CPU-rung engine re-plans (impl/pad may differ):
+            # repo-standard tolerance for changed accumulation order
+            np.testing.assert_allclose(got.scores_of(t),
+                                       base.scores_of(t),
+                                       rtol=1e-4, atol=1e-6)
+
+    def test_oom_surfaces_when_cpu_rung_disabled(self):
+        fresh, train = self._engine(cpu_fallback=False)
+        pts = np.asarray(train.x[:4])
+        with inject.active(
+            inject.Fault("engine.dispatch_flat", at=0, kind=taxonomy.OOM)
+        ):
+            with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+                fresh.query_batch(pts)
+
+    def test_nan_solve_escalates_lissa_to_cg(self):
+        # damping 2.0: the random-init block Hessian is PD there, so a
+        # CLEAN lissa run converges and only the injected NaN escalates
+        model, params, train = _setup()
+        pts = np.asarray(train.x[:4])
+        clean = InfluenceEngine(model, params, train, damping=2.0,
+                                solver="lissa")
+        base = clean.query_batch(pts)
+        assert clean.solver == "lissa"  # no spurious escalation
+        eng = InfluenceEngine(model, params, train, damping=2.0,
+                              solver="lissa")
+        with inject.active(
+            inject.Fault("engine.solve", at=0, kind=taxonomy.NAN)
+        ):
+            got = eng.query_batch(pts)
+        assert eng.solver == "cg"  # sticky escalation
+        assert taxonomy.classify_payload(np.asarray(got.ihvp)) is None
+        for t in range(len(pts)):
+            # lissa (clean) vs cg (escalated): two convergent solvers
+            np.testing.assert_allclose(got.scores_of(t),
+                                       base.scores_of(t), rtol=1e-3,
+                                       atol=1e-6)
+
+    def test_nan_ladder_reaches_direct(self):
+        model, params, train = _setup()
+        pts = np.asarray(train.x[:2])
+        eng = InfluenceEngine(model, params, train, damping=2.0,
+                              solver="lissa")
+        with inject.active(
+            inject.Fault("engine.solve", at=0, kind=taxonomy.NAN),
+            inject.Fault("engine.solve", at=1, kind=taxonomy.NAN),
+        ):
+            got = eng.query_batch(pts)
+        assert eng.solver == "direct"  # lissa -> cg -> direct
+        assert taxonomy.classify_payload(np.asarray(got.ihvp)) is None
+
+    def test_query_many_journal_resume_recomputes_nothing(self, tmp_path):
+        eng, train = self._engine()
+        pts = np.asarray(train.x[:4])
+        path = str(tmp_path / "qm.jsonl")
+        fp = eng.journal_fingerprint(pts, batch_queries=2)
+        with Journal.open(path, fp, fsync=False) as j:
+            base = eng.query_many(pts, batch_queries=2, journal=j)
+        with Journal.open(path, fp, resume=True, fsync=False) as j2:
+            with inject.active() as inj:  # empty plan: just counts calls
+                got = eng.query_many(pts, batch_queries=2, journal=j2)
+            assert inj.counts.get("engine.dispatch_flat", 0) == 0
+        for g, b in zip(got, base):
+            np.testing.assert_array_equal(g.counts, b.counts)
+            for t in range(len(g.counts)):
+                np.testing.assert_array_equal(g.scores_of(t),
+                                              b.scores_of(t))
+
+    def test_query_many_deadline_stops_cleanly_then_resumes(self, tmp_path):
+        eng, train = self._engine()
+        pts = np.asarray(train.x[:4])
+        path = str(tmp_path / "dl.jsonl")
+        fp = eng.journal_fingerprint(pts, batch_queries=2)
+        with Journal.open(path, fp, fsync=False) as j:
+            with pytest.raises(taxonomy.DeadlineExpired):
+                eng.query_many(pts, batch_queries=2, journal=j,
+                               deadline=rpolicy.Deadline(1e-9))
+        base = eng.query_many(pts, batch_queries=2)
+        with Journal.open(path, fp, resume=True, fsync=False) as j2:
+            got = eng.query_many(pts, batch_queries=2, journal=j2)
+        for g, b in zip(got, base):
+            for t in range(len(g.counts)):
+                np.testing.assert_array_equal(g.scores_of(t),
+                                              b.scores_of(t))
+
+
+class TestTrainerRetry:
+    def test_transient_epoch_fault_retries_bit_identical(self):
+        model, params, train = _setup()
+        cfg = TrainConfig(batch_size=100, num_steps=30,
+                          learning_rate=1e-2)
+        clean = Trainer(model, cfg).fit(
+            Trainer(model, cfg).init_state(params), train.x, train.y
+        )
+        t2 = Trainer(model, cfg, retry_policy=FAST)
+        with inject.active(
+            inject.Fault("trainer.epoch", at=0, kind=taxonomy.WORKER)
+        ) as inj:
+            got = t2.fit(t2.init_state(params), train.x, train.y)
+        assert inj.unfired() == []
+        # functional step inputs are reused, so the retried epoch is
+        # bit-identical to the uninterrupted one
+        for a, b in zip(jax.tree_util.tree_leaves(got.params),
+                        jax.tree_util.tree_leaves(clean.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_non_transient_fault_surfaces(self):
+        model, params, train = _setup()
+        cfg = TrainConfig(batch_size=100, num_steps=10,
+                          learning_rate=1e-2)
+        t = Trainer(model, cfg, retry_policy=FAST)
+        with inject.active(
+            inject.Fault("trainer.epoch", at=0, kind=taxonomy.OOM)
+        ):
+            with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+                t.fit(t.init_state(params), train.x, train.y)
+
+
+class TestDistributedRetry:
+    def test_put_global_retries_transient_placement(self):
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        from fia_tpu.parallel.distributed import put_global
+
+        mesh = Mesh(np.asarray(jax.devices("cpu")[:8]), ("data",))
+        x = np.arange(16.0, dtype=np.float32)
+        with inject.active(
+            inject.Fault("distributed.put_global", at=0,
+                         kind=taxonomy.WORKER)
+        ) as inj:
+            out = put_global(mesh, x, P("data"))
+        assert inj.counts["distributed.put_global"] == 2
+        np.testing.assert_array_equal(np.asarray(out), x)
+
+
+class TestRq1Resume:
+    """ISSUE acceptance: an RQ1 chain killed mid-run and restarted with
+    --resume recomputes zero completed points and emits a byte-identical
+    npz artifact."""
+
+    ARGS = [
+        "--dataset", "synthetic", "--model", "MF",
+        "--synth_users", "40", "--synth_items", "30",
+        "--synth_train", "1200", "--synth_test", "50",
+        "--num_steps_train", "300", "--num_steps_retrain", "120",
+        "--num_test", "2", "--retrain_times", "1",
+        "--embed_size", "4", "--batch_size", "150",
+        "--lr", "1e-2", "--num_to_remove", "6",
+    ]
+
+    @pytest.fixture(scope="class")
+    def chain(self, tmp_path_factory):
+        from fia_tpu.cli import rq1
+
+        d = tmp_path_factory.mktemp("rq1resume")
+        rq1.main(self.ARGS + ["--train_dir", str(d)])
+        art = d / "RQ1-MF-synthetic.npz"
+        journal = d / ".RQ1-MF-synthetic.journal.jsonl"
+        assert art.exists() and journal.exists()
+        return d, art, art.read_bytes(), journal.read_text()
+
+    def test_full_resume_recomputes_zero_points(self, chain, monkeypatch):
+        from fia_tpu.cli import rq1
+        from fia_tpu import eval as _eval  # noqa: F401
+
+        d, art, full_bytes, _ = chain
+
+        def forbidden(*a, **k):
+            raise AssertionError("resume recomputed a completed point")
+
+        import fia_tpu.eval.rq1 as eval_rq1
+
+        monkeypatch.setattr(eval_rq1, "test_retraining", forbidden)
+        rq1.main(self.ARGS + ["--train_dir", str(d), "--resume"])
+        assert art.read_bytes() == full_bytes
+
+    def test_killed_mid_chain_resume_byte_identical(self, chain,
+                                                    monkeypatch):
+        from fia_tpu.cli import rq1
+        import fia_tpu.eval.rq1 as eval_rq1
+
+        d, art, full_bytes, journal_text = chain
+        # simulate a kill after the first point: journal keeps only the
+        # header + first record, the partially-written npz is gone
+        lines = journal_text.strip().splitlines()
+        assert len(lines) == 3  # header + 2 points
+        (d / ".RQ1-MF-synthetic.journal.jsonl").write_text(
+            "\n".join(lines[:2]) + "\n"
+        )
+        art.unlink()
+        real = eval_rq1.test_retraining
+        calls = []
+
+        def counting(*a, **k):
+            calls.append(1)
+            return real(*a, **k)
+
+        monkeypatch.setattr(eval_rq1, "test_retraining", counting)
+        rq1.main(self.ARGS + ["--train_dir", str(d), "--resume"])
+        assert len(calls) == 1  # only the lost second point
+        assert art.read_bytes() == full_bytes
+
+    def test_mismatched_resume_fails_loudly(self, chain):
+        from fia_tpu.cli import rq1
+
+        d, art, _, _ = chain
+        art.unlink(missing_ok=True)
+        args = [a for a in self.ARGS]
+        args[args.index("--num_to_remove") + 1] = "7"
+        with pytest.raises(JournalMismatch):
+            rq1.main(args + ["--train_dir", str(d), "--resume"])
+
+
+class TestArtifactLadderCollision:
+    def test_digested_path_collision_fails_loudly(self, tmp_path):
+        """Satellite: the sha1[:8] model-digest rung is checked for
+        occupancy too — a collision there must never silently clobber
+        banked rows."""
+        import argparse
+
+        from fia_tpu.cli.rq1 import artifact_path
+
+        args = argparse.Namespace(
+            num_steps_retrain=100, retrain_times=2, num_to_remove=5,
+            num_test=2, maxinf=True, seed=0, test_indices=None,
+        )
+
+        def occupy(path):
+            np.savez(path,
+                     protocol=np.asarray([100, 2, 5, 2, 1, 0], np.int64),
+                     stream_tag=np.asarray(""),
+                     model_key=np.asarray("someone-else"))
+
+        ladder = []
+        for _ in range(3):  # canonical -> protocol divert -> digest
+            p = artifact_path(str(tmp_path), "MF", "synthetic", args,
+                              np.asarray([1, 2]), "", model_key="mine")
+            assert p not in ladder
+            ladder.append(p)
+            occupy(p)
+        with pytest.raises(SystemExit, match="ladder exhausted"):
+            artifact_path(str(tmp_path), "MF", "synthetic", args,
+                          np.asarray([1, 2]), "", model_key="mine")
