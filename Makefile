@@ -1,12 +1,20 @@
 # Convenience targets. The commands themselves are pinned in
 # ROADMAP.md (tier-1) and scripts/ — these targets just name them.
 
-.PHONY: tier1 test
+.PHONY: tier1 test lint-io
 
 # The ROADMAP.md tier-1 verify: fast CPU suite, slow tests excluded.
+# The raw-writes lint runs first as a non-fatal report (the `-` prefix);
+# `make lint-io` is the enforcing form.
 tier1:
+	-bash scripts/check_raw_writes.sh
 	bash scripts/tier1.sh
 
 # Full suite (includes slow-marked tests; needs more wall clock).
 test:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -p no:cacheprovider
+
+# Enforced: artifact writes outside utils/io.py + reliability/artifacts.py
+# fail the build.
+lint-io:
+	bash scripts/check_raw_writes.sh

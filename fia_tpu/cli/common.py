@@ -132,6 +132,13 @@ def base_parser(desc: str) -> argparse.ArgumentParser:
                         "chain stops cleanly between test points when "
                         "the budget is spent, with all completed points "
                         "journaled for --resume")
+    p.add_argument("--checkpoint_every", type=int, default=0,
+                   help="publish a rotated training checkpoint every N "
+                        "steps so a killed run auto-resumes from the "
+                        "last good generation (0 = auto: num_steps/4; "
+                        "-1 disables periodic checkpointing)")
+    p.add_argument("--checkpoint_keep", type=int, default=3,
+                   help="rotated checkpoint generations to retain")
     return p
 
 
@@ -298,9 +305,36 @@ def build_model(args, splits):
     return model, params
 
 
+def train_fingerprint(args, name, num_steps, batch) -> dict:
+    """The training-run config fingerprint stamped on checkpoint
+    manifests. One resolver for terminal AND rotated generations, so a
+    checkpoint from a different config (seed, step budget, lr) is
+    rejected at restore time rather than silently trusted."""
+    return {
+        "kind": "train-ckpt",
+        "model_key": name,
+        "seed": int(args.seed),
+        "num_steps": int(num_steps),
+        "batch": int(batch),
+        "lr": float(args.lr),
+    }
+
+
 def train_or_load(args, model, params, splits, num_steps=None, verbose=True,
                   event_log=None, mesh=None):
-    """Reference RQ2.py:102-109 train-or-load behavior."""
+    """Reference RQ2.py:102-109 train-or-load behavior, crash-safe.
+
+    Restore ladder: (1) the terminal checkpoint when valid; (2) the
+    newest valid rotated generation from a prior killed run (training
+    resumes from its step, not step 0); (3) train from scratch. Training
+    publishes rotated generations every --checkpoint_every steps, and a
+    corrupt/mismatched terminal checkpoint falls through this ladder
+    instead of crashing the driver.
+    """
+    from fia_tpu.reliability.artifacts import ArtifactIntegrityError
+    from fia_tpu.train.trainer import TrainState
+    from fia_tpu.utils.io import sweep_stale_tmps
+
     num_steps = num_steps or args.num_steps_train
     train = splits["train"]
     batch = batch_size_for(args, train)
@@ -310,23 +344,59 @@ def train_or_load(args, model, params, splits, num_steps=None, verbose=True,
     trainer = Trainer(model, cfg, event_log=event_log, mesh=mesh)
     state = trainer.init_state(params)
 
-    ckpt = os.path.join(
-        args.train_dir,
-        f"{model_name_for(args, splits=splits)}-checkpoint-{num_steps - 1}",
-    )
+    name = model_name_for(args, splits=splits)
+    ckpt = os.path.join(args.train_dir, f"{name}-checkpoint-{num_steps - 1}")
+    fp = train_fingerprint(args, name, num_steps, batch)
+    sweep_stale_tmps(args.train_dir)
+
     if args.load_checkpoint and checkpoint.exists(ckpt):
         print(f"Checkpoint found, loading {ckpt}")
-        p, o, step = checkpoint.load(ckpt, state.params, state.opt_state)
-        from fia_tpu.train.trainer import TrainState
-        state = TrainState(p, o if o is not None else state.opt_state, step)
-    else:
+        try:
+            p, o, step = checkpoint.load(ckpt, state.params, state.opt_state)
+            return trainer, TrainState(
+                p, o if o is not None else state.opt_state, step
+            ), batch
+        except (ArtifactIntegrityError, ValueError) as e:
+            # corrupt terminal checkpoint: quarantined by the integrity
+            # layer; fall through to rotated generations / retraining
+            print(f"Terminal checkpoint rejected ({e}); falling back")
+
+    ckpter = None
+    every = int(getattr(args, "checkpoint_every", 0))
+    if every == 0:
+        every = max(1, num_steps // 4)
+    if every > 0:
+        ckpter = checkpoint.PeriodicCheckpointer(
+            os.path.join(args.train_dir, f"{name}-ckpts"),
+            every=every, keep=int(getattr(args, "checkpoint_keep", 3)),
+            fingerprint=fp,
+        )
+
+    if args.load_checkpoint and ckpter is not None:
+        restored = checkpoint.restore_latest_valid(
+            ckpter.dir_path, state.params, state.opt_state,
+            fingerprint=fp, verbose=verbose,
+        )
+        if restored is not None:
+            p, o, step = restored
+            state = TrainState(
+                p, o if o is not None else state.opt_state, step
+            )
+            ckpter._last_step = step
+
+    remaining = num_steps - state.step
+    if remaining > 0:
         if verbose:
-            print(f"Training {args.model} for {num_steps} steps (batch {batch})")
-        state = trainer.fit(state, train.x, train.y)
-        os.makedirs(args.train_dir, exist_ok=True)
-        checkpoint.save(ckpt, state.params, state.opt_state, state.step)
-        if verbose:
-            print(f"Saved checkpoint {ckpt}")
+            what = "Resuming" if state.step else "Training"
+            print(f"{what} {args.model} at step {state.step}/{num_steps} "
+                  f"(batch {batch})")
+        state = trainer.fit(state, train.x, train.y, num_steps=remaining,
+                            checkpointer=ckpter)
+    os.makedirs(args.train_dir, exist_ok=True)
+    checkpoint.save(ckpt, state.params, state.opt_state, state.step,
+                    fingerprint=fp)
+    if verbose:
+        print(f"Saved checkpoint {ckpt}")
     return trainer, state, batch
 
 

@@ -18,7 +18,7 @@ import numpy as np
 from fia_tpu.cli import common
 from fia_tpu.reliability import policy as rpolicy
 from fia_tpu.reliability.journal import Journal
-from fia_tpu.utils.io import save_npz_atomic
+from fia_tpu.reliability.artifacts import publish_npz
 
 
 def artifact_path(train_dir, model, dataset, args, test_indices, tag,
@@ -201,27 +201,34 @@ def main(argv=None):
         # drift lane and original prediction ride alongside so the
         # noise-floor decomposition (scripts/fidelity_spread.py) can run
         # from the artifact alone
-        save_npz_atomic(
+        # published through the integrity layer: the npz bytes stay
+        # identical to a plain savez (resume byte-identity contract),
+        # and the sidecar manifest binds the rows to the same journal
+        # fingerprint that guards --resume
+        publish_npz(
             art_path,
-            actual_loss_diffs=np.concatenate(actuals),
-            predicted_loss_diffs=np.concatenate(predictions),
-            indices_to_remove=np.concatenate(removed),
-            test_index_of_row=np.repeat(
-                [int(i) for i in test_indices[: len(actuals)]],
-                [len(a) for a in actuals],
+            dict(
+                actual_loss_diffs=np.concatenate(actuals),
+                predicted_loss_diffs=np.concatenate(predictions),
+                indices_to_remove=np.concatenate(removed),
+                test_index_of_row=np.repeat(
+                    [int(i) for i in test_indices[: len(actuals)]],
+                    [len(a) for a in actuals],
+                ),
+                repeat_y=np.concatenate(repeat_rows),
+                drift_repeat_y=np.stack(drift_rows),
+                y0_of_point=np.asarray(y0s, np.float32),
+                # provenance (r4): lets artifact_path distinguish a
+                # same-protocol re-run (overwrite) from a different run
+                # (divert), and lets post-processing label rows
+                protocol=np.asarray([args.num_steps_retrain,
+                                     args.retrain_times, args.num_to_remove,
+                                     args.num_test, int(args.maxinf),
+                                     args.seed], np.int64),
+                stream_tag=np.asarray(tag),
+                model_key=np.asarray(model_key),
             ),
-            repeat_y=np.concatenate(repeat_rows),
-            drift_repeat_y=np.stack(drift_rows),
-            y0_of_point=np.asarray(y0s, np.float32),
-            # provenance (r4): lets artifact_path distinguish a
-            # same-protocol re-run (overwrite) from a different run
-            # (divert), and lets post-processing label rows
-            protocol=np.asarray([args.num_steps_retrain,
-                                 args.retrain_times, args.num_to_remove,
-                                 args.num_test, int(args.maxinf),
-                                 args.seed], np.int64),
-            stream_tag=np.asarray(tag),
-            model_key=np.asarray(model_key),
+            fingerprint=fingerprint,
         )
 
     saved = False

@@ -1623,27 +1623,36 @@ class InfluenceEngine:
             # since this engine fuses solving and scoring in one program.
             # The filename key (reference-shaped) doesn't identify the
             # trained params, so a fingerprint guards against serving
-            # scores from a different checkpoint; unreadable or
-            # pre-scores files recompute and rewrite.
+            # scores from a different checkpoint. The hit is verified
+            # through the artifact integrity layer first: a corrupt file
+            # (torn write, bit rot) is quarantined as *.corrupt and
+            # treated as a miss — recompute, then publish a clean entry.
+            from fia_tpu.reliability import artifacts
+
             try:
-                with np.load(cache) as hit:
-                    if "scores" in hit and (
-                        "params_fp" in hit
-                        and self._fingerprint_matches(hit["params_fp"])
-                    ):
-                        return hit["scores"]
-            except Exception:
+                hit = artifacts.load_npz(cache, require_manifest=False)
+                if "scores" in hit and (
+                    "params_fp" in hit
+                    and self._fingerprint_matches(hit["params_fp"])
+                ):
+                    return hit["scores"]
+            except artifacts.ArtifactIntegrityError:
                 pass
             stale = True
         res = self.query_batch(point[None, :])
         if cache is not None and (
             force_refresh or stale or not os.path.exists(cache)
         ):
-            from fia_tpu.utils.io import save_npz_atomic
+            from fia_tpu.reliability import artifacts
 
-            save_npz_atomic(cache, inverse_hvp=res.ihvp[0],
-                            scores=res.scores_of(0),
-                            params_fp=self._params_fingerprint())
+            artifacts.publish_npz(
+                cache,
+                dict(inverse_hvp=res.ihvp[0], scores=res.scores_of(0),
+                     params_fp=self._params_fingerprint()),
+                fingerprint={"model_key": self.model_name,
+                             "solver": self.solver},
+                site="engine.cache_publish",
+            )
         return res.scores_of(0)
 
     def _params_fingerprint(self) -> np.ndarray:

@@ -24,8 +24,20 @@ guesswork (no resume path at all — the round-5 measurement program lost
 - :mod:`~fia_tpu.reliability.journal` — a fingerprinted JSONL progress
   journal powering resumable ``query_many`` streams and the RQ1 chain
   (``python -m fia_tpu.cli.rq1 --resume``).
+- :mod:`~fia_tpu.reliability.artifacts` — the crash-safe artifact
+  integrity layer: fsync'd atomic publishes with checksummed,
+  fingerprinted sidecar manifests, verification on read, and quarantine
+  (``*.corrupt``) of anything that fails it. Checkpoint rotation /
+  last-good fallback, the engine's verified iHVP cache, and training
+  auto-resume are built on it.
 
 See ``docs/reliability.md`` for the full design.
 """
 
-from fia_tpu.reliability import inject, journal, policy, taxonomy  # noqa: F401
+from fia_tpu.reliability import (  # noqa: F401
+    artifacts,
+    inject,
+    journal,
+    policy,
+    taxonomy,
+)

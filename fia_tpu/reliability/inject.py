@@ -22,7 +22,8 @@ signatures (the r3/r4 worker-death and tunnel-500 strings), so the
 taxonomy classifies injected faults exactly like real ones — the test
 never talks to the classifier directly.
 
-Sites currently wired (grep for ``inject.fire``/``inject.corrupt``):
+Sites currently wired (grep for ``inject.fire``/``inject.corrupt``/
+``inject.damage``):
 
 ==========================  ================================================
 ``engine.upload``           device-state (re)build in ``_upload_device_state``
@@ -33,7 +34,18 @@ Sites currently wired (grep for ``inject.fire``/``inject.corrupt``):
 ``trainer.epoch``           one compiled-epoch dispatch in ``Trainer.fit``
 ``trainer.loo_segment``     one LOO retraining segment dispatch
 ``distributed.put_global``  global-array placement
+``artifacts.publish``       generic artifact publish (``damage`` kinds)
+``checkpoint.publish``      one rotated/terminal checkpoint publish
+``engine.cache_publish``    one inverse-HVP cache entry publish
 ==========================  ================================================
+
+On-disk corruption kinds (fired through :func:`damage`, applied AFTER a
+publish completes so the atomic-write path itself stays honest):
+``torn`` truncates the published file to half its bytes, ``bitflip``
+flips one bit at the middle byte, ``stale_manifest`` rewrites the
+sidecar manifest's checksum to another generation's — each a distinct
+way the integrity layer's read-side verification must catch what the
+write-side atomicity cannot.
 
 Thread-safety: the armed plan is process-global module state (like a
 real fault domain); arm it from the test thread only.
@@ -41,12 +53,32 @@ real fault domain); arm it from the test thread only.
 
 from __future__ import annotations
 
+import json
+import os
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 
 import numpy as np
 
 from fia_tpu.reliability import taxonomy
+
+# Artifact-corruption kinds (the damage channel). Not taxonomy kinds:
+# they never raise — they mutate bytes on disk, and the read-side
+# integrity layer (reliability/artifacts.py) must classify the result.
+TORN = "torn"
+BITFLIP = "bitflip"
+STALE_MANIFEST = "stale_manifest"
+ARTIFACT_KINDS = frozenset({TORN, BITFLIP, STALE_MANIFEST})
+
+
+def _channel(kind: str) -> str:
+    """Which injection channel a fault kind fires on: ``raise`` (fire),
+    ``payload`` (corrupt), or ``artifact`` (damage)."""
+    if kind == taxonomy.NAN:
+        return "payload"
+    if kind in ARTIFACT_KINDS:
+        return "artifact"
+    return "raise"
 
 # Observed production signatures (BASELINE §4.1, engine.py history) —
 # injected faults must classify identically to the real thing.
@@ -79,7 +111,9 @@ class Fault:
     ``kind``: a taxonomy kind — ``oom`` / ``ambiguous`` / ``worker`` /
     ``preemption`` raise a RuntimeError carrying the observed signature,
     ``host_oom`` raises :class:`MemoryError`, ``nan`` corrupts the
-    payload passed through :func:`corrupt` (it never raises).
+    payload passed through :func:`corrupt` (it never raises) — or an
+    artifact kind (``torn`` / ``bitflip`` / ``stale_manifest``) that
+    mutates the on-disk file passed through :func:`damage`.
     ``message``: optional signature override.
     """
 
@@ -103,12 +137,12 @@ class Injector:
         self.counts[site] = idx + 1
         return idx
 
-    def _match(self, site: str, idx: int, *, nan: bool):
+    def _match(self, site: str, idx: int, channel: str):
         for f in self.faults:
             if (
                 f.site == site
                 and f.at == idx
-                and (f.kind == taxonomy.NAN) == nan
+                and _channel(f.kind) == channel
                 and not f.fired
             ):
                 return f
@@ -116,7 +150,7 @@ class Injector:
 
     def fire(self, site: str) -> None:
         idx = self._tick(site)
-        f = self._match(site, idx, nan=False)
+        f = self._match(site, idx, "raise")
         if f is None:
             return
         f.fired = True
@@ -130,7 +164,7 @@ class Injector:
 
     def corrupt(self, site: str, array):
         idx = self._tick(site)
-        f = self._match(site, idx, nan=True)
+        f = self._match(site, idx, "payload")
         if f is None:
             return array
         f.fired = True
@@ -139,6 +173,36 @@ class Injector:
         if out.size:
             out.reshape(-1)[0] = np.nan
         return out
+
+    def damage(self, site: str, path: str, manifest_path: str | None) -> None:
+        idx = self._tick(site)
+        f = self._match(site, idx, "artifact")
+        if f is None:
+            return
+        f.fired = True
+        self.log.append((site, idx, f.kind))
+        if f.kind == TORN:
+            # a torn write: the file stops mid-byte-stream
+            os.truncate(path, os.path.getsize(path) // 2)
+        elif f.kind == BITFLIP:
+            # single-bit rot at the middle byte: size (and usually the
+            # zip envelope) stay plausible — only the checksum can tell
+            with open(path, "r+b") as fh:
+                off = max(0, os.path.getsize(path) // 2 - 1)
+                fh.seek(off)
+                b = fh.read(1) or b"\x00"
+                fh.seek(off)
+                fh.write(bytes([b[0] ^ 0x01]))
+        elif f.kind == STALE_MANIFEST and manifest_path and os.path.exists(
+            manifest_path
+        ):
+            # a manifest left behind by a previous generation of the
+            # file: internally well-formed, checksum of different bytes
+            with open(manifest_path) as fh:
+                m = json.load(fh)
+            m["checksum"] = "sha256:" + "0" * 64
+            with open(manifest_path, "w") as fh:
+                json.dump(m, fh)
 
     def unfired(self) -> list[Fault]:
         return [f for f in self.faults if not f.fired]
@@ -161,6 +225,14 @@ def corrupt(site: str, array):
     if _active is not None:
         return _active.corrupt(site, array)
     return array
+
+
+def damage(site: str, path: str, manifest_path: str | None = None) -> None:
+    """On-disk injection site: applies a scheduled ``torn`` /
+    ``bitflip`` / ``stale_manifest`` corruption to a just-published
+    artifact. A no-op (one global read) when no plan is armed."""
+    if _active is not None:
+        _active.damage(site, path, manifest_path)
 
 
 def call_count(site: str) -> int:

@@ -4,15 +4,38 @@ Reference equivalent: full-session ``tf.train.Saver`` checkpoints plus a
 params-only restore (``genericNeuralNet.py:149, 407-429``). Here a
 checkpoint is the (params, opt_state, step) triple saved as an npz of
 flattened pytree leaves; loading restores into a template with matching
-structure. An orbax-backed variant is provided for async/multi-host use.
+structure, leaf shapes AND dtypes (two configs with identical tree
+structure but different embedding dims must never restore into each
+other). An orbax-backed variant is provided for async/multi-host use.
+
+Persistence goes through the artifact integrity layer
+(``fia_tpu/reliability/artifacts.py``): every save is an fsync'd atomic
+publish with a checksummed, fingerprinted sidecar manifest, and every
+load verifies before deserialising. On top of that sit the crash-safety
+pieces this module owns:
+
+- :func:`save_rotated` — a last-K rotated checkpoint directory
+  (``ckpt-<step>.npz`` generations, oldest pruned);
+- :func:`restore_latest_valid` — walk back from the newest generation to
+  the first one that passes checksum + fingerprint + template
+  validation, quarantining corrupt generations (``*.corrupt``) along
+  the way;
+- :class:`PeriodicCheckpointer` — the trainer-side hook that publishes a
+  generation every N steps, so a killed training run auto-resumes from
+  the last good step instead of step 0.
 """
 
 from __future__ import annotations
 
 import os
+import re
 
 import jax
 import numpy as np
+
+from fia_tpu.reliability import artifacts
+
+_GEN_RE = re.compile(r"^ckpt-(\d+)\.npz$")
 
 
 def _flatten(tree):
@@ -20,8 +43,9 @@ def _flatten(tree):
     return leaves, str(treedef)
 
 
-def save(path: str, params, opt_state=None, step: int = 0) -> str:
-    """Save a checkpoint; returns the file path."""
+def save(path: str, params, opt_state=None, step: int = 0,
+         fingerprint=None) -> str:
+    """Durably publish a checkpoint (npz + manifest); returns the path."""
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     leaves, treedef = _flatten(params)
     payload = {f"p{i}": np.asarray(l) for i, l in enumerate(leaves)}
@@ -32,37 +56,70 @@ def save(path: str, params, opt_state=None, step: int = 0) -> str:
         payload["__otree__"] = np.array(otreedef)
     payload["__step__"] = np.array(step)
     out = path if path.endswith(".npz") else path + ".npz"
-    # write-to-temp + atomic rename: a concurrent reader (e.g. a chip
-    # experiment loading a checkpoint another backend's run is just
-    # rewriting) must never see a half-written zip
-    tmp = f"{out[:-4]}.tmp.{os.getpid()}.npz"  # np.savez appends .npz itself
-    np.savez(tmp, **payload)
-    os.replace(tmp, out)
+    artifacts.publish_npz(out, payload, fingerprint=fingerprint,
+                          site="checkpoint.publish")
     return out
 
 
-def load(path: str, params_template, opt_template=None):
+def _validate_leaves(got, template, path: str, what: str) -> None:
+    """Leaf-level shape/dtype validation against the template.
+
+    The treedef string match catches structural mismatches but is blind
+    to leaf shapes — two configs differing only in embedding dim restore
+    silently into wrong-shaped params without this."""
+    t_leaves = jax.tree_util.tree_leaves(template)
+    if len(got) != len(t_leaves):
+        raise ValueError(
+            f"checkpoint {what} leaf count {len(got)} != template "
+            f"{len(t_leaves)} in {path}"
+        )
+    for i, (g, t) in enumerate(zip(got, t_leaves)):
+        ts = tuple(np.shape(t))
+        gs = tuple(np.shape(g))
+        if ts != gs:
+            raise ValueError(
+                f"checkpoint {what} leaf {i} shape {gs} != template "
+                f"{ts} in {path}"
+            )
+        td = np.asarray(t).dtype if not hasattr(t, "dtype") else np.dtype(t.dtype)
+        if np.dtype(g.dtype) != td:
+            raise ValueError(
+                f"checkpoint {what} leaf {i} dtype {g.dtype} != template "
+                f"{td} in {path}"
+            )
+
+
+def load(path: str, params_template, opt_template=None, *,
+         fingerprint=None, require_manifest: bool = False):
     """Load a checkpoint into (params, opt_state, step).
 
-    Structures are validated against the provided templates, mirroring
-    the reference's Saver var-list matching.
+    The file is verified against its integrity manifest first (lenient
+    on manifest-less legacy files unless ``require_manifest``); corrupt
+    files are quarantined and raise
+    :class:`~fia_tpu.reliability.artifacts.ArtifactIntegrityError`.
+    Structures, leaf shapes and dtypes are then validated against the
+    provided templates, mirroring the reference's Saver var-list
+    matching (ValueError on mismatch).
     """
     if not path.endswith(".npz"):
         path = path + ".npz"
-    with np.load(path, allow_pickle=False) as z:
-        pleaves = [z[f"p{i}"] for i in range(_count(z, "p"))]
-        _, ptreedef = jax.tree_util.tree_flatten(params_template)
-        if str(ptreedef) != str(z["__ptree__"]):
-            raise ValueError(f"checkpoint param structure mismatch in {path}")
-        params = jax.tree_util.tree_unflatten(ptreedef, pleaves)
-        opt_state = None
-        if opt_template is not None and "__otree__" in z:
-            oleaves = [z[f"o{i}"] for i in range(_count(z, "o"))]
-            _, otreedef = jax.tree_util.tree_flatten(opt_template)
-            if str(otreedef) != str(z["__otree__"]):
-                raise ValueError(f"checkpoint opt structure mismatch in {path}")
-            opt_state = jax.tree_util.tree_unflatten(otreedef, oleaves)
-        step = int(z["__step__"])
+    z = artifacts.load_npz(path, expected_fingerprint=fingerprint,
+                           require_manifest=require_manifest)
+    pleaves = [z[f"p{i}"] for i in range(_count(z, "p"))]
+    _, ptreedef = jax.tree_util.tree_flatten(params_template)
+    if str(ptreedef) != str(z["__ptree__"]):
+        raise ValueError(f"checkpoint param structure mismatch in {path}")
+    _validate_leaves(pleaves, params_template, path, "param")
+    params = jax.tree_util.tree_unflatten(ptreedef, pleaves)
+    opt_state = None
+    if opt_template is not None and "__otree__" in z:
+        oleaves = [z[f"o{i}"] for i in range(_count(z, "o"))]
+        _, otreedef = jax.tree_util.tree_flatten(opt_template)
+        if str(otreedef) != str(z["__otree__"]):
+            raise ValueError(f"checkpoint opt structure mismatch in {path}")
+        _validate_leaves(oleaves, opt_template, path, "opt")
+        opt_state = jax.tree_util.tree_unflatten(otreedef, oleaves)
+    step = int(z["__step__"])
     return params, opt_state, step
 
 
@@ -75,3 +132,111 @@ def _count(z, prefix: str) -> int:
 
 def exists(path: str) -> bool:
     return os.path.isfile(path if path.endswith(".npz") else path + ".npz")
+
+
+# -- rotated last-K generations + last-good-fallback restore ---------------
+
+def generations(dir_path: str) -> list[tuple[int, str]]:
+    """(step, path) of every checkpoint generation, oldest first.
+
+    Quarantined (``*.corrupt``) files never match the generation name
+    pattern, so they are invisible here — evidence stays on disk but is
+    never re-read."""
+    if not os.path.isdir(dir_path):
+        return []
+    gens = []
+    for name in os.listdir(dir_path):
+        m = _GEN_RE.match(name)
+        if m:
+            gens.append((int(m.group(1)), os.path.join(dir_path, name)))
+    return sorted(gens)
+
+
+def save_rotated(dir_path: str, params, opt_state=None, step: int = 0, *,
+                 keep: int = 3, fingerprint=None) -> str:
+    """Publish ``ckpt-<step>.npz`` into a rotated last-K directory.
+
+    Older generations beyond ``keep`` are pruned (retention policy —
+    pruning valid history is not evidence destruction; quarantined
+    ``*.corrupt`` files are never touched). Stale temp files from a
+    previously killed writer are swept first.
+    """
+    from fia_tpu.utils.io import sweep_stale_tmps
+
+    os.makedirs(dir_path, exist_ok=True)
+    sweep_stale_tmps(dir_path)
+    out = save(os.path.join(dir_path, f"ckpt-{int(step):08d}.npz"),
+               params, opt_state, step, fingerprint=fingerprint)
+    gens = generations(dir_path)
+    for _, stale_path in gens[:-keep] if keep > 0 else []:
+        if os.path.abspath(stale_path) == os.path.abspath(out):
+            continue
+        for p in (stale_path, artifacts.manifest_path(stale_path)):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+    return out
+
+
+def restore_latest_valid(dir_path: str, params_template, opt_template=None,
+                         *, fingerprint=None, verbose: bool = True):
+    """Restore the newest generation that passes full validation.
+
+    Walks generations newest-first; a generation failing checksum/size/
+    manifest verification is quarantined (by the integrity layer) and
+    the walk continues to the next-older one. A generation with a
+    mismatched *fingerprint* or template (another config's checkpoint in
+    a shared dir) is skipped but left in place — it is evidence of
+    nothing and may belong to someone else. Returns (params, opt_state,
+    step) or None when no valid generation exists.
+    """
+    for step, path in reversed(generations(dir_path)):
+        try:
+            out = load(path, params_template, opt_template,
+                       fingerprint=fingerprint, require_manifest=True)
+        except artifacts.ArtifactIntegrityError as e:
+            if verbose:
+                print(f"[artifacts] checkpoint {os.path.basename(path)} "
+                      f"rejected ({e.reason}); falling back to an older "
+                      "generation")
+            continue
+        except ValueError as e:
+            if verbose:
+                print(f"[artifacts] checkpoint {os.path.basename(path)} "
+                      f"skipped (template mismatch: {e})")
+            continue
+        if verbose:
+            print(f"[artifacts] restored step {step} from "
+                  f"{os.path.basename(path)}")
+        return out
+    return None
+
+
+class PeriodicCheckpointer:
+    """Publishes rotated checkpoint generations every ``every`` steps.
+
+    The trainer calls :meth:`maybe` at dispatch boundaries (the only
+    points where params are consistent on host); saves land through
+    :func:`save_rotated`, so a kill at ANY moment leaves a restorable
+    last-good generation for :func:`restore_latest_valid`.
+    ``every <= 0`` disables periodic saves (maybe() is a cheap no-op).
+    """
+
+    def __init__(self, dir_path: str, every: int, keep: int = 3,
+                 fingerprint=None):
+        self.dir_path = dir_path
+        self.every = int(every)
+        self.keep = int(keep)
+        self.fingerprint = fingerprint
+        self._last_step = 0
+
+    def maybe(self, params, opt_state, step: int) -> str | None:
+        if self.every <= 0 or step - self._last_step < self.every:
+            return None
+        return self.save(params, opt_state, step)
+
+    def save(self, params, opt_state, step: int) -> str:
+        self._last_step = int(step)
+        return save_rotated(self.dir_path, params, opt_state, step,
+                            keep=self.keep, fingerprint=self.fingerprint)

@@ -28,8 +28,9 @@ def save(path: str, params, opt_state=None, step: int = 0) -> str:
 
 
 def _check_like(template, got):
-    """Raise if ``got`` doesn't match the template's tree/shapes — the
-    template-less raw restore must not accept a mismatched checkpoint."""
+    """Raise if ``got`` doesn't match the template's tree/shapes/dtypes —
+    the template-less raw restore must not accept a mismatched
+    checkpoint."""
     tdef = jax.tree_util.tree_structure(template)
     gdef = jax.tree_util.tree_structure(got)
     if tdef != gdef:
@@ -40,6 +41,10 @@ def _check_like(template, got):
         gs = tuple(getattr(g, "shape", ()))
         if ts != gs:
             raise ValueError(f"checkpoint leaf shape {gs} != template {ts}")
+        td = getattr(t, "dtype", None)
+        gd = getattr(g, "dtype", None)
+        if td is not None and gd is not None and td != gd:
+            raise ValueError(f"checkpoint leaf dtype {gd} != template {td}")
 
 
 def load(path: str, params_template, opt_template=None):

@@ -173,8 +173,16 @@ class Trainer:
         y,
         weights=None,
         num_steps: int | None = None,
+        checkpointer=None,
     ) -> TrainState:
-        """Run ``num_steps`` training steps (cfg.num_steps by default)."""
+        """Run ``num_steps`` training steps (cfg.num_steps by default).
+
+        ``checkpointer`` (a ``train.checkpoint.PeriodicCheckpointer``)
+        publishes a rotated checkpoint generation at dispatch boundaries
+        — the only points where (params, opt_state, step) are consistent
+        on host — so a killed run restarts from the last good generation
+        via ``restore_latest_valid`` instead of step 0.
+        """
         cfg = self.config
         num_steps = cfg.num_steps if num_steps is None else num_steps
         n = x.shape[0]
@@ -242,6 +250,8 @@ class Trainer:
                 dispatch_epoch, retry_on=taxonomy.TRANSIENT
             )
             done += todo
+            if checkpointer is not None:
+                checkpointer.maybe(params, opt_state, state.step + done)
             if cfg.log_every and ((epoch_i + 1) % max(1, cfg.log_every // nb) == 0):
                 print(f"step {state.step + done}: "
                       f"loss = {float(losses[r + todo - 1]):.6f}")

@@ -1,29 +1,145 @@
-"""Small IO helpers shared by the engine cache and experiment drivers."""
+"""Small IO helpers shared by the engine cache and experiment drivers.
+
+This module owns the low-level durable-write primitives; the integrity
+layer on top (checksums, manifests, quarantine) is
+``fia_tpu/reliability/artifacts.py``. New artifact writers should go
+through that layer — ``scripts/check_raw_writes.sh`` flags raw
+``np.savez`` / ``open(.., "wb")`` writes anywhere else.
+"""
 
 from __future__ import annotations
 
+import hashlib
 import os
+import re
 import tempfile
 
 import numpy as np
 
+# Temp-file naming embeds the writer's pid so a kill between write and
+# rename leaves something sweep_stale_tmps can prove is dead:
+#   .npztmp.<pid>.XXXXXX.npz      (this module's mkstemp pattern)
+#   <stem>.tmp.<pid>.npz          (the legacy checkpoint.save pattern)
+_TMP_PATTERNS = (
+    re.compile(r"^\.npztmp\.(\d+)\..*\.npz$"),
+    re.compile(r"\.tmp\.(\d+)\.npz$"),
+    re.compile(r"^\.manifest-tmp\.(\d*).*\.json$"),  # pid-less: see sweep
+)
 
-def save_npz_atomic(path: str, **arrays) -> None:
-    """np.savez published by atomic rename.
+
+def fsync_dir(path: str) -> None:
+    """fsync a directory so a just-renamed entry survives power loss.
+
+    ``os.replace`` makes the rename atomic against concurrent readers,
+    but the new directory entry itself is not durable until the
+    directory inode is synced — a kill after replace could resurface
+    the old file (or nothing). Best-effort: some platforms/filesystems
+    refuse directory fsync; that degrades to the pre-PR durability, not
+    an error.
+    """
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def save_npz_atomic(path: str, **arrays) -> tuple[str, str, int]:
+    """np.savez published by fsync'd write + atomic rename.
 
     A kill mid-write must never leave a truncated npz at ``path`` (the
     engine's inverse-HVP cache is read back; RQ sweeps accumulate hours
     of results in one file). A private mkstemp tmp also keeps concurrent
-    writers from interleaving into each other's files.
+    writers from interleaving into each other's files. The temp file is
+    fsync'd before the rename and the directory after it, so the
+    published bytes are durable — not just atomic — at return.
+
+    Returns ``(path, sha256_hex, size)`` of the published bytes, so the
+    integrity layer (reliability/artifacts.py) can stamp its manifest
+    without re-reading the file it just wrote.
     """
     d = os.path.dirname(os.path.abspath(path))
     os.makedirs(d, exist_ok=True)
-    fd, tmp = tempfile.mkstemp(dir=d, suffix=".npz")
+    fd, tmp = tempfile.mkstemp(
+        dir=d, prefix=f".npztmp.{os.getpid()}.", suffix=".npz"
+    )
     try:
         with os.fdopen(fd, "wb") as f:
             np.savez(f, **arrays)
+            f.flush()
+            os.fsync(f.fileno())
+        sha = _file_sha256(tmp)
+        size = os.path.getsize(tmp)
         os.replace(tmp, path)
     except BaseException:
         if os.path.exists(tmp):
             os.unlink(tmp)
         raise
+    fsync_dir(d)
+    return path, sha, size
+
+
+def _file_sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except (PermissionError, OSError):
+        return True  # exists but not ours — leave its temp files alone
+    return True
+
+
+def sweep_stale_tmps(dirpath: str) -> list[str]:
+    """Remove temp files abandoned by a killed writer; return them.
+
+    A kill between write and rename leaves ``.npztmp.<pid>.*.npz`` /
+    ``*.tmp.<pid>.npz`` droppings that would otherwise accumulate
+    forever. A temp file is provably stale when its embedded pid is no
+    longer a live process; files whose writer is still alive (including
+    this process) are untouched. pid-less manifest temps are swept only
+    when their mtime is over an hour old.
+    """
+    removed: list[str] = []
+    if not os.path.isdir(dirpath):
+        return removed
+    import time
+
+    for name in os.listdir(dirpath):
+        for pat in _TMP_PATTERNS:
+            m = pat.search(name)
+            if not m:
+                continue
+            full = os.path.join(dirpath, name)
+            pid = int(m.group(1)) if m.group(1) else None
+            stale = (
+                not _pid_alive(pid) if pid is not None
+                else _older_than(full, 3600.0, time.time())
+            )
+            if stale:
+                try:
+                    os.unlink(full)
+                    removed.append(full)
+                except OSError:
+                    pass
+            break
+    return removed
+
+
+def _older_than(path: str, age_s: float, now: float) -> bool:
+    try:
+        return now - os.path.getmtime(path) > age_s
+    except OSError:
+        return False

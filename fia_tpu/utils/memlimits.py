@@ -15,14 +15,25 @@ Best-effort by design: concurrent writers publish atomically (private
 tmp + rename, the same convention as the inverse-HVP cache —
 docs/design.md §9) and the worst outcome of a lost update is exactly
 the status quo ante: one extra learning failure in some later process.
-Corrupt or unreadable files are ignored and overwritten.
+
+Integrity: every write seals the file with an ``__integrity__`` record
+(magic + sha256 of the canonical entries JSON). A sealed file whose
+checksum no longer matches its entries — bit rot, a torn concurrent
+write — is quarantined (renamed ``*.corrupt``, evidence preserved,
+never re-read) and treated as absent; a pre-seal legacy file is
+accepted as-is. Wrong-*shaped* but well-formed JSON is tolerated as a
+virgin cache (it is not provably ours to quarantine).
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import tempfile
+
+_MAGIC = "fia-memlimits-v1"
+_SEAL = "__integrity__"
 
 _ENV = "FIA_MEMLIMIT_CACHE"
 _DEFAULT = os.path.join("output", ".mem_limits.json")
@@ -56,16 +67,67 @@ def key(
     return f"{backend}:n{int(num_devices)}:{model_name}:d{int(block_dim)}"
 
 
+def _entries_checksum(entries: dict) -> str:
+    canon = json.dumps(entries, sort_keys=True)
+    return "sha256:" + hashlib.sha256(canon.encode()).hexdigest()
+
+
+def _quarantine(path: str) -> None:
+    """Rename a damaged cache aside (``*.corrupt``, incremented on
+    collision) so the evidence survives but is never re-read."""
+    dst = path + ".corrupt"
+    n = 0
+    while os.path.exists(dst):
+        n += 1
+        dst = f"{path}.corrupt.{n}"
+    try:
+        os.replace(path, dst)
+        print(f"[memlimits] quarantined corrupt cache -> "
+              f"{os.path.basename(dst)}")
+    except OSError:
+        pass
+
+
+def _open_checked(path: str) -> dict:
+    """Entries from the cache file, seal-verified.
+
+    Unparseable files and sealed files whose checksum mismatches are
+    quarantined and read as empty; legacy (seal-less) and wrong-shaped
+    files are read as empty/as-is without quarantine.
+    """
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except OSError:
+        return {}
+    except ValueError:
+        _quarantine(path)
+        return {}
+    if not isinstance(data, dict):
+        return {}
+    seal = data.pop(_SEAL, None)
+    if seal is not None:
+        ok = (
+            isinstance(seal, dict)
+            and seal.get("magic") == _MAGIC
+            and seal.get("checksum") == _entries_checksum(data)
+        )
+        if not ok:
+            _quarantine(path)
+            return {}
+    return data
+
+
 def load(k: str) -> tuple[int, int]:
     """(cells_ok, cells_bad) previously learned for key ``k``.
 
     Returns (0, _UNSET_BAD) — the engine's virgin state — when the
-    cache is absent, unreadable, wrong-shaped, or has no entry.
+    cache is absent, unreadable, corrupt (quarantined), wrong-shaped,
+    or has no entry.
     """
     try:
-        with open(_path()) as f:
-            data = json.load(f)
-        entry = data.get(k) if isinstance(data, dict) else None
+        data = _open_checked(_path())
+        entry = data.get(k)
         if not isinstance(entry, dict):
             return 0, _UNSET_BAD
         ok = max(0, int(entry.get("cells_ok", 0)))
@@ -111,13 +173,7 @@ def update(
     if not os.path.isdir(d):
         return
     try:
-        try:
-            with open(path) as f:
-                data = json.load(f)
-            if not isinstance(data, dict):
-                data = {}
-        except (OSError, ValueError):
-            data = {}
+        data = _open_checked(path)
         prev = data.get(k)
         if not isinstance(prev, dict):
             prev = {}
@@ -141,10 +197,16 @@ def update(
         if merged == prev:
             return
         data[k] = merged
+        sealed = dict(data)
+        sealed[_SEAL] = {
+            "magic": _MAGIC, "checksum": _entries_checksum(data)
+        }
         fd, tmp = tempfile.mkstemp(dir=d, prefix=".mem_limits.")
         try:
             with os.fdopen(fd, "w") as f:
-                json.dump(data, f)
+                json.dump(sealed, f)
+                f.flush()
+                os.fsync(f.fileno())
             os.replace(tmp, path)
         except BaseException:
             try:
@@ -152,5 +214,8 @@ def update(
             except OSError:
                 pass
             raise
+        from fia_tpu.utils.io import fsync_dir
+
+        fsync_dir(d)
     except OSError:
         pass  # best-effort: a lost update costs one re-learning failure

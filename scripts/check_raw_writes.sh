@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# Lint: artifact bytes must be published through the integrity layer.
+#
+# Flags raw `np.savez(` / `open(..., "wb")` artifact writes in
+# fia_tpu/ outside the two modules allowed to own them:
+#   - fia_tpu/utils/io.py            (the durable-write primitive)
+#   - fia_tpu/reliability/artifacts.py (checksummed publish on top)
+# Everything else goes through artifacts.publish_npz so every persisted
+# file gets an fsync'd atomic write + verified sidecar manifest.
+#
+# Exit 1 when violations are found (wired into `make lint-io`; the
+# `make tier1` hook runs it non-fatally as a report).
+set -u
+cd "$(dirname "$0")/.."
+
+ALLOW='fia_tpu/(utils/io|reliability/artifacts)\.py'
+
+violations=$(
+  grep -rnE 'np\.savez\(|open\([^)]*,[[:space:]]*"wb"' fia_tpu/ \
+    --include='*.py' \
+    | grep -vE "^${ALLOW}:" \
+    || true
+)
+
+if [ -n "$violations" ]; then
+  echo "raw artifact writes outside the integrity layer" \
+       "(route through fia_tpu.reliability.artifacts.publish_npz):"
+  echo "$violations"
+  exit 1
+fi
+echo "check_raw_writes: OK (all artifact writes go through the integrity layer)"

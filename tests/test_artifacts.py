@@ -1,0 +1,490 @@
+"""The artifact integrity layer (fia_tpu/reliability/artifacts.py) and
+everything built on it: durable atomic publishes with checksummed
+manifests, verify-on-read with quarantine, rotated checkpoints with
+last-good fallback, the verified iHVP cache, and training auto-resume.
+
+Corruption is driven through the injection harness's on-disk damage
+channel (``torn`` / ``bitflip`` / ``stale_manifest``) so the exact
+fallback rungs are exercised deterministically on CPU. Resume
+assertions are exact (bit-identical params): the trainer's epoch keys
+fold from the absolute step and partial epochs are step-masked, so a
+resumed run replays the uninterrupted run's batch schedule verbatim.
+"""
+
+import json
+import os
+import subprocess
+
+import jax
+import numpy as np
+import pytest
+
+from fia_tpu.data.dataset import RatingDataset
+from fia_tpu.influence.engine import InfluenceEngine
+from fia_tpu.models import MF
+from fia_tpu.reliability import artifacts, inject, taxonomy
+from fia_tpu.reliability import policy as rpolicy
+from fia_tpu.train import checkpoint
+from fia_tpu.train.trainer import Trainer, TrainConfig
+from fia_tpu.utils import io as uio
+
+U, I, K = 30, 20, 4
+WD = 1e-2
+DAMP = 1e-3
+FAST = rpolicy.RetryPolicy(max_attempts=3, base_delay=0.0, jitter=0.0)
+
+
+def _setup(seed=0, n=400):
+    rng = np.random.default_rng(seed)
+    x = np.stack(
+        [rng.integers(0, U, n), rng.integers(0, I, n)], axis=1
+    ).astype(np.int32)
+    y = rng.integers(1, 6, n).astype(np.float32)
+    train = RatingDataset(x, y)
+    model = MF(U, I, K, WD)
+    params = model.init_params(jax.random.PRNGKey(seed))
+    return model, params, train
+
+
+def _leaves_equal(a, b):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+class TestPublishVerify:
+    def test_roundtrip_and_manifest_contents(self, tmp_path):
+        p = str(tmp_path / "a.npz")
+        arrays = {"x": np.arange(7), "y": np.ones((2, 3), np.float32)}
+        artifacts.publish_npz(p, arrays, fingerprint={"seed": 3})
+        z = artifacts.load_npz(p, expected_fingerprint={"seed": 3},
+                               require_manifest=True)
+        np.testing.assert_array_equal(z["x"], arrays["x"])
+        np.testing.assert_array_equal(z["y"], arrays["y"])
+        with open(artifacts.manifest_path(p)) as f:
+            m = json.load(f)
+        assert m["magic"] == artifacts.MAGIC
+        assert m["checksum"] == f"sha256:{artifacts.file_sha256(p)}"
+        assert m["size"] == os.path.getsize(p)
+        assert m["keys"] == ["x", "y"]
+        assert m["fingerprint"] == {"seed": 3}
+
+    def test_fingerprint_mismatch_is_not_quarantined(self, tmp_path):
+        p = str(tmp_path / "a.npz")
+        artifacts.publish_npz(p, {"x": np.arange(3)}, fingerprint={"seed": 0})
+        with pytest.raises(artifacts.ArtifactIntegrityError) as ei:
+            artifacts.load_npz(p, expected_fingerprint={"seed": 1})
+        assert ei.value.reason == "fingerprint-mismatch"
+        # an intact file from another config is evidence of nothing:
+        # still on disk under its own name, readable by its owner
+        assert os.path.exists(p)
+        assert artifacts.load_npz(p, expected_fingerprint={"seed": 0})
+
+    def test_missing_file_and_lenient_manifestless_read(self, tmp_path):
+        with pytest.raises(artifacts.ArtifactIntegrityError) as ei:
+            artifacts.load_npz(str(tmp_path / "absent.npz"))
+        assert ei.value.reason == "missing-file"
+        # legacy manifest-less file: lenient mode reads it, strict
+        # mode quarantines (a file without its manifest is suspect —
+        # e.g. a kill landed between file and manifest publish)
+        p = str(tmp_path / "legacy.npz")
+        artifacts.publish_npz(p, {"x": np.arange(3)})
+        os.unlink(artifacts.manifest_path(p))
+        assert "x" in artifacts.load_npz(p, require_manifest=False)
+        with pytest.raises(artifacts.ArtifactIntegrityError) as ei:
+            artifacts.load_npz(p, require_manifest=True)
+        assert ei.value.reason == "missing-manifest"
+        assert not os.path.exists(p)
+        assert os.path.exists(p + ".corrupt")
+
+    @pytest.mark.parametrize("kind,reason", [
+        (inject.TORN, "size-mismatch"),
+        (inject.BITFLIP, "checksum-mismatch"),
+        (inject.STALE_MANIFEST, "checksum-mismatch"),
+    ])
+    def test_injected_damage_detected_and_quarantined(self, tmp_path,
+                                                      kind, reason):
+        p = str(tmp_path / "a.npz")
+        with inject.active(inject.Fault("artifacts.publish", at=0,
+                                        kind=kind)) as inj:
+            artifacts.publish_npz(p, {"x": np.arange(100)})
+            assert not inj.unfired()
+        with pytest.raises(artifacts.ArtifactIntegrityError) as ei:
+            artifacts.load_npz(p, require_manifest=True)
+        assert ei.value.reason == reason
+        # quarantined: original name freed, evidence preserved, and the
+        # poison is never re-read (a fresh read sees a clean miss)
+        assert not os.path.exists(p)
+        assert os.path.exists(p + ".corrupt")
+        with pytest.raises(artifacts.ArtifactIntegrityError) as ei:
+            artifacts.load_npz(p)
+        assert ei.value.reason == "missing-file"
+
+    def test_quarantine_increments_on_collision(self, tmp_path):
+        p = str(tmp_path / "a.npz")
+        for expect in ("a.npz.corrupt", "a.npz.corrupt.1"):
+            artifacts.publish_npz(p, {"x": np.arange(4)})
+            os.truncate(p, 3)
+            with pytest.raises(artifacts.ArtifactIntegrityError):
+                artifacts.load_npz(p)
+            assert os.path.exists(str(tmp_path / expect))
+
+    def test_unreadable_payload_with_consistent_manifest(self, tmp_path):
+        # checksum matches bytes that are nonetheless not an npz (e.g.
+        # the manifest was stamped over garbage by a broken writer):
+        # the parse failure is wrapped, not leaked mid-np.load
+        p = str(tmp_path / "a.npz")
+        with open(p, "wb") as f:
+            f.write(b"not a zip at all")
+        artifacts._write_atomic_json(artifacts.manifest_path(p), {
+            "magic": artifacts.MAGIC,
+            "checksum": f"sha256:{artifacts.file_sha256(p)}",
+            "size": os.path.getsize(p),
+            "fingerprint": None, "keys": [],
+        })
+        with pytest.raises(artifacts.ArtifactIntegrityError) as ei:
+            artifacts.load_npz(p)
+        assert ei.value.reason == "unreadable"
+        assert os.path.exists(p + ".corrupt")
+
+
+class TestDurability:
+    def test_save_npz_atomic_reports_published_bytes(self, tmp_path):
+        p = str(tmp_path / "a.npz")
+        out, sha, size = uio.save_npz_atomic(p, x=np.arange(10))
+        assert out == p
+        assert sha == artifacts.file_sha256(p)
+        assert size == os.path.getsize(p)
+
+    def test_sweep_removes_dead_writer_tmps_only(self, tmp_path):
+        proc = subprocess.Popen(["true"])  # a pid that provably exited
+        proc.wait()
+        dead, live = proc.pid, os.getpid()
+        names = {
+            f".npztmp.{dead}.abc.npz": True,
+            f"ck.tmp.{dead}.npz": True,          # legacy checkpoint tmp
+            f".npztmp.{live}.abc.npz": False,    # writer still alive
+            "ckpt-00000008.npz": False,          # published, not a tmp
+            "a.npz.corrupt": False,              # evidence, never swept
+        }
+        for n in names:
+            (tmp_path / n).write_bytes(b"x")
+        removed = uio.sweep_stale_tmps(str(tmp_path))
+        for n, should_go in names.items():
+            assert os.path.exists(tmp_path / n) != should_go, n
+        assert len(removed) == 2
+
+
+class TestCheckpointValidation:
+    def _params(self):
+        return {"w": np.ones((3, 2), np.float32),
+                "b": np.zeros((2,), np.float32)}
+
+    def test_roundtrip_with_manifest(self, tmp_path):
+        p = str(tmp_path / "ck")
+        params = self._params()
+        opt = (np.full(3, 2.0, np.float32),)
+        out = checkpoint.save(p, params, opt, 7, fingerprint={"m": "k"})
+        assert os.path.exists(artifacts.manifest_path(out))
+        rp, ro, step = checkpoint.load(p, params, opt, fingerprint={"m": "k"})
+        assert step == 7
+        _leaves_equal(rp, params)
+        _leaves_equal(ro, opt)
+
+    def test_shape_mismatch_rejected(self, tmp_path):
+        p = str(tmp_path / "ck")
+        checkpoint.save(p, self._params())
+        bad = {"w": np.ones((3, 5), np.float32),   # different embed dim,
+               "b": np.zeros((2,), np.float32)}    # same treedef string
+        with pytest.raises(ValueError, match="shape"):
+            checkpoint.load(p, bad)
+
+    def test_dtype_mismatch_rejected(self, tmp_path):
+        p = str(tmp_path / "ck")
+        checkpoint.save(p, self._params())
+        bad = {"w": np.ones((3, 2), np.float64),
+               "b": np.zeros((2,), np.float32)}
+        with pytest.raises(ValueError, match="dtype"):
+            checkpoint.load(p, bad)
+
+    def test_treedef_mismatch_rejected(self, tmp_path):
+        p = str(tmp_path / "ck")
+        checkpoint.save(p, self._params())
+        with pytest.raises(ValueError):
+            checkpoint.load(p, {"other": np.ones((3, 2), np.float32)})
+
+
+class TestRestoreLatestValid:
+    STEPS = (8, 16, 24)
+
+    def _fill(self, d, fingerprint={"run": "a"}):
+        params = None
+        by_step = {}
+        for step in self.STEPS:
+            params = {"w": np.full((4, 3), float(step), np.float32)}
+            opt = (np.full((2,), float(step), np.float32),)
+            checkpoint.save_rotated(str(d), params, opt, step, keep=5,
+                                    fingerprint=fingerprint)
+            by_step[step] = (params, opt)
+        return params, by_step
+
+    @pytest.mark.parametrize("kind", [inject.TORN, inject.BITFLIP,
+                                      inject.STALE_MANIFEST])
+    def test_corrupt_newest_falls_back_one_generation(self, tmp_path, kind):
+        _, by_step = self._fill(tmp_path)
+        newest = checkpoint.generations(str(tmp_path))[-1][1]
+        # same damage the injection harness applies, on the at-rest file
+        inj = inject.Injector([inject.Fault("s", at=0, kind=kind)])
+        inj.damage("s", newest, artifacts.manifest_path(newest))
+        tmpl = {"w": np.zeros((4, 3), np.float32)}
+        otmpl = (np.zeros((2,), np.float32),)
+        out = checkpoint.restore_latest_valid(
+            str(tmp_path), tmpl, otmpl, fingerprint={"run": "a"})
+        assert out is not None
+        p, o, step = out
+        assert step == self.STEPS[-2]
+        _leaves_equal(p, by_step[step][0])
+        _leaves_equal(o, by_step[step][1])
+        # the bad generation was quarantined, not deleted
+        assert os.path.exists(newest + ".corrupt")
+        assert not os.path.exists(newest)
+
+    def test_wrong_fingerprint_skipped_but_kept(self, tmp_path):
+        self._fill(tmp_path)
+        newest_step = self.STEPS[-1]
+        # overwrite the newest generation under a different run config
+        checkpoint.save_rotated(
+            str(tmp_path), {"w": np.full((4, 3), -1.0, np.float32)},
+            (np.zeros((2,), np.float32),), newest_step, keep=5,
+            fingerprint={"run": "b"},
+        )
+        tmpl = {"w": np.zeros((4, 3), np.float32)}
+        out = checkpoint.restore_latest_valid(
+            str(tmp_path), tmpl, (np.zeros((2,), np.float32),),
+            fingerprint={"run": "a"})
+        assert out is not None and out[2] == self.STEPS[-2]
+        # not corruption: the foreign generation stays under its name
+        gens = dict(checkpoint.generations(str(tmp_path)))
+        assert newest_step in gens
+        assert not os.path.exists(gens[newest_step] + ".corrupt")
+
+    def test_all_corrupt_returns_none(self, tmp_path):
+        self._fill(tmp_path)
+        for _, path in checkpoint.generations(str(tmp_path)):
+            os.truncate(path, os.path.getsize(path) // 2)
+        out = checkpoint.restore_latest_valid(
+            str(tmp_path), {"w": np.zeros((4, 3), np.float32)})
+        assert out is None
+        assert checkpoint.generations(str(tmp_path)) == []
+        corrupt = [n for n in os.listdir(tmp_path) if ".corrupt" in n]
+        assert len(corrupt) >= len(self.STEPS)
+
+    def test_rotation_prunes_valid_but_spares_quarantined(self, tmp_path):
+        params = {"w": np.ones((2, 2), np.float32)}
+        checkpoint.save_rotated(str(tmp_path), params, None, 1, keep=2)
+        oldest = checkpoint.generations(str(tmp_path))[0][1]
+        os.truncate(oldest, 4)
+        with pytest.raises(artifacts.ArtifactIntegrityError):
+            artifacts.load_npz(oldest)  # quarantines gen 1
+        for step in (2, 3, 4, 5):
+            checkpoint.save_rotated(str(tmp_path), params, None, step, keep=2)
+        assert [s for s, _ in checkpoint.generations(str(tmp_path))] == [4, 5]
+        assert os.path.exists(oldest + ".corrupt")  # evidence retained
+
+
+class TestEngineCacheIntegrity:
+    def test_torn_cache_entry_quarantines_and_recomputes(self, tmp_path):
+        """Regression (tentpole satellite): a truncated iHVP cache file
+        must be treated as a miss — quarantined, recomputed, atomically
+        rewritten — and the healed scores must equal the clean ones."""
+        model, params, train = _setup()
+        test_ds = RatingDataset(np.array([[3, 5]], np.int32),
+                                np.array([4.0]))
+        eng = InfluenceEngine(model, params, train, damping=DAMP,
+                              cache_dir=str(tmp_path), model_name="m")
+        with inject.active(inject.Fault("engine.cache_publish", at=0,
+                                        kind=inject.TORN)) as inj:
+            clean = eng.get_influence_on_test_loss([0], test_ds)
+            assert not inj.unfired()
+        cache, = list(tmp_path.glob("*.npz"))
+        assert os.path.getsize(cache) < int(
+            json.load(open(artifacts.manifest_path(str(cache))))["size"]
+        )
+        healed = eng.get_influence_on_test_loss([0], test_ds,
+                                                force_refresh=False)
+        np.testing.assert_allclose(healed, clean)
+        assert list(tmp_path.glob("*.npz.corrupt"))  # evidence kept
+        # the rewrite published a verifiable entry that now serves hits
+        cache, = list(tmp_path.glob("*.npz"))
+        artifacts.verify(str(cache))
+        eng.query_batch = None  # any further recompute would raise
+        hit = eng.get_influence_on_test_loss([0], test_ds,
+                                             force_refresh=False)
+        np.testing.assert_allclose(hit, clean)
+
+
+class TestTrainerAutoResume:
+    N, BATCH, STEPS, EVERY = 400, 100, 40, 8
+
+    def _fit(self, tmp_path=None, faults=(), state=None, num_steps=None):
+        model, params, train = _setup(n=self.N)
+        cfg = TrainConfig(batch_size=self.BATCH, num_steps=self.STEPS,
+                          learning_rate=1e-2, seed=0)
+        trainer = Trainer(model, cfg, retry_policy=FAST)
+        if state is None:
+            state = trainer.init_state(params)
+        ckpter = None
+        if tmp_path is not None:
+            ckpter = checkpoint.PeriodicCheckpointer(
+                str(tmp_path), every=self.EVERY, keep=3,
+                fingerprint={"run": "t"})
+            ckpter._last_step = state.step
+        if faults:
+            with inject.active(*faults):
+                with pytest.raises(RuntimeError):
+                    trainer.fit(state, train.x, train.y,
+                                num_steps=num_steps, checkpointer=ckpter)
+            return None, trainer.init_state(params)
+        return trainer.fit(state, train.x, train.y, num_steps=num_steps,
+                           checkpointer=ckpter), state
+
+    def test_killed_run_resumes_bit_identical(self, tmp_path):
+        """Kill training mid-run (injected non-transient OOM at the 7th
+        epoch dispatch), restore the newest valid rotated generation,
+        finish — final params must be BIT-identical to an uninterrupted
+        run (the absolute-step epoch keys + step masks replay the same
+        batch schedule)."""
+        clean, _ = self._fit()  # no checkpointing, uninterrupted
+
+        _, fresh = self._fit(
+            tmp_path,
+            faults=[inject.Fault("trainer.epoch", at=6, kind=taxonomy.OOM)],
+        )
+        # nb=4: dispatches 0..5 completed 24 steps; gens at 8, 16, 24
+        gens = [s for s, _ in checkpoint.generations(str(tmp_path))]
+        assert gens == [8, 16, 24]
+        restored = checkpoint.restore_latest_valid(
+            str(tmp_path), fresh.params, fresh.opt_state,
+            fingerprint={"run": "t"})
+        assert restored is not None and restored[2] == 24
+        from fia_tpu.train.trainer import TrainState
+
+        resumed, _ = self._fit(
+            tmp_path,
+            state=TrainState(restored[0], restored[1], restored[2]),
+            num_steps=self.STEPS - restored[2],
+        )
+        assert resumed.step == self.STEPS
+        _leaves_equal(resumed.params, clean.params)
+
+    def test_train_or_load_auto_resumes(self, tmp_path):
+        """Driver-level integration: a killed `train_or_load` rerun in
+        the same --train_dir restores the rotated generation and lands
+        on the same params as an uninterrupted run in a clean dir."""
+        from fia_tpu.cli import common
+
+        def make_args(train_dir):
+            return common.base_parser("t").parse_args([
+                "--dataset", "synthetic", "--model", "MF",
+                "--synth_users", "40", "--synth_items", "30",
+                "--synth_train", "1200", "--synth_test", "40",
+                "--num_steps_train", "32", "--batch_size", "150",
+                "--checkpoint_every", "8", "--train_dir", str(train_dir),
+                "--embed_size", "4", "--log_file", "none",
+            ])
+
+        args_a = make_args(tmp_path / "a")
+        splits = common.load_splits(args_a)
+        model, params = common.build_model(args_a, splits)
+        _, state_a, _ = common.train_or_load(
+            args_a, model, params, splits, verbose=False)
+
+        args_b = make_args(tmp_path / "b")
+        with inject.active(
+            inject.Fault("trainer.epoch", at=2, kind=taxonomy.OOM)
+        ):
+            with pytest.raises(RuntimeError):
+                common.train_or_load(args_b, model, params, splits,
+                                     verbose=False)
+        # nb=8: two dispatches (16 steps) completed before the kill
+        ckdirs = [d for d in os.listdir(tmp_path / "b")
+                  if d.endswith("-ckpts")]
+        assert len(ckdirs) == 1
+        gens = checkpoint.generations(str(tmp_path / "b" / ckdirs[0]))
+        assert [s for s, _ in gens] == [8, 16]
+
+        _, state_b, _ = common.train_or_load(
+            args_b, model, params, splits, verbose=False)
+        assert state_b.step == state_a.step == 32
+        _leaves_equal(state_b.params, state_a.params)
+
+        # third call: the terminal checkpoint now exists and serves
+        trainer_c, state_c, _ = common.train_or_load(
+            args_b, model, params, splits, verbose=False)
+        _leaves_equal(state_c.params, state_a.params)
+
+    def test_corrupt_terminal_checkpoint_falls_through(self, tmp_path):
+        """A corrupt terminal checkpoint must not crash the driver: it
+        falls through the ladder (quarantine -> rotated generations ->
+        retrain) and ends with a clean terminal checkpoint again."""
+        from fia_tpu.cli import common
+
+        args = common.base_parser("t").parse_args([
+            "--dataset", "synthetic", "--model", "MF",
+            "--synth_users", "40", "--synth_items", "30",
+            "--synth_train", "1200", "--synth_test", "40",
+            "--num_steps_train", "32", "--batch_size", "150",
+            "--checkpoint_every", "8", "--train_dir", str(tmp_path),
+            "--embed_size", "4", "--log_file", "none",
+        ])
+        splits = common.load_splits(args)
+        model, params = common.build_model(args, splits)
+        _, state_a, _ = common.train_or_load(args, model, params, splits,
+                                             verbose=False)
+        term = [f for f in os.listdir(tmp_path)
+                if "-checkpoint-" in f and f.endswith(".npz")]
+        assert len(term) == 1
+        tpath = tmp_path / term[0]
+        os.truncate(tpath, os.path.getsize(tpath) // 2)
+        _, state_b, _ = common.train_or_load(args, model, params, splits,
+                                             verbose=False)
+        assert state_b.step == 32
+        _leaves_equal(state_b.params, state_a.params)
+        assert os.path.exists(str(tpath) + ".corrupt")
+        artifacts.verify(str(tpath))  # rewritten clean
+
+
+class TestMemlimitsIntegrity:
+    def test_seal_roundtrip_and_tamper_quarantine(self, tmp_path,
+                                                  monkeypatch):
+        from fia_tpu.utils import memlimits
+
+        f = tmp_path / "m.json"
+        monkeypatch.setenv("FIA_MEMLIMIT_CACHE", str(f))
+        memlimits.update("k", 100, 1000)
+        data = json.load(open(f))
+        assert data["__integrity__"]["magic"] == "fia-memlimits-v1"
+        assert memlimits.load("k") == (100, 1000)
+        # tamper with an entry, keeping the JSON well-formed: the seal
+        # checksum no longer matches -> quarantined -> virgin
+        data["k"]["cells_ok"] = 10_000_000
+        f.write_text(json.dumps(data))
+        assert memlimits.load("k") == (0, memlimits.UNSET_BAD)
+        assert not f.exists()
+        assert (tmp_path / "m.json.corrupt").exists()
+        # and a fresh update starts a clean sealed file
+        memlimits.update("k", 5, 50)
+        assert memlimits.load("k") == (5, 50)
+
+    def test_legacy_unsealed_file_accepted(self, tmp_path, monkeypatch):
+        from fia_tpu.utils import memlimits
+
+        f = tmp_path / "m.json"
+        monkeypatch.setenv("FIA_MEMLIMIT_CACHE", str(f))
+        f.write_text('{"k": {"cells_ok": 7, "cells_bad": 70}}')
+        assert memlimits.load("k") == (7, 70)
+        memlimits.update("k", 9, 60)  # upgrade seals in place
+        assert json.load(open(f)).get("__integrity__")
+        assert memlimits.load("k") == (9, 60)
