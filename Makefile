@@ -1,7 +1,7 @@
 # Convenience targets. The commands themselves are pinned in
 # ROADMAP.md (tier-1) and scripts/ — these targets just name them.
 
-.PHONY: tier1 test lint-io
+.PHONY: tier1 test lint-io serve-smoke
 
 # The ROADMAP.md tier-1 verify: fast CPU suite, slow tests excluded.
 # The raw-writes lint runs first as a non-fatal report (the `-` prefix);
@@ -18,3 +18,8 @@ test:
 # fail the build.
 lint-io:
 	bash scripts/check_raw_writes.sh
+
+# Serving smoke: 200-query synthetic stream through fia_tpu.cli.serve
+# on CPU (<60s) — zero unreasoned drops, hot-cache hits, latency report.
+serve-smoke:
+	bash scripts/serve_smoke.sh

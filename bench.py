@@ -501,5 +501,114 @@ def main():
                 fh.write(json.dumps(out) + "\n")
 
 
+def serve_main():
+    """``python bench.py serve [--quick]`` — open-loop serving load.
+
+    Measures the online service (fia_tpu/serve) the way an operator
+    would size it: first a closed-loop capacity probe (how fast can
+    micro-batched dispatch drain a saturated queue), then an open-loop
+    stream offered at ~1.2x that capacity — arrivals don't wait for
+    completions, so the admission controller must shed the excess.
+    Prints ONE JSON line: sustained qps, queue-wait/solve percentiles,
+    cache hit rate, and the shed accounting (every reject must carry a
+    reason; "dropped_unreasoned" is asserted zero).
+    """
+    _ensure_live_backend()
+    import jax
+
+    from fia_tpu.data.synthetic import sample_heldout_pairs, synthesize_ratings
+    from fia_tpu.influence.engine import InfluenceEngine
+    from fia_tpu.models import MF
+    from fia_tpu.serve import InfluenceService, Request, ServeConfig
+    from fia_tpu.train.trainer import Trainer, TrainConfig
+
+    if QUICK:
+        users, items, rows, steps, n_req = 300, 200, 20_000, 1_000, 300
+    else:
+        users, items, rows, steps, n_req = 600, 400, 50_000, 3_000, 1_000
+    k, wd, damping, batch, max_batch = 16, 1e-3, 1e-6, 2000, 32
+
+    _stage(f"serve bench: training {steps} steps on {rows} rows")
+    train = synthesize_ratings(users, items, rows, seed=0)
+    model = MF(users, items, k, wd)
+    tr = Trainer(model, TrainConfig(batch_size=batch, num_steps=steps,
+                                    learning_rate=1e-2))
+    state = tr.fit(tr.init_state(model.init_params(jax.random.PRNGKey(0))),
+                   train.x, train.y)
+    engine = InfluenceEngine(model, state.params, train, damping=damping,
+                             solver="direct")
+
+    pool = sample_heldout_pairs(train.x, users, items,
+                                max(n_req // 4, 64), seed=17)
+    rng = np.random.default_rng(23)
+    # repeat-heavy stream: half the requests revisit a small hot set
+    hot = pool[rng.choice(len(pool), size=max(len(pool) // 8, 4),
+                          replace=False)]
+    def draw():
+        src = hot if rng.random() < 0.5 else pool
+        u, i = src[rng.integers(len(src))]
+        return Request(user=int(u), item=int(i))
+
+    # closed-loop capacity probe (also warms the compile caches)
+    probe = InfluenceService(engine=engine, config=ServeConfig(
+        max_batch=max_batch, max_queue=10 * max_batch))
+    probe_n = 4 * max_batch
+    svc_warm = probe.run([draw() for _ in range(probe_n)],
+                         drain_every=max_batch)
+    t0 = time.perf_counter()
+    probe.run([draw() for _ in range(probe_n)], drain_every=max_batch)
+    capacity_qps = probe_n / (time.perf_counter() - t0)
+    _stage(f"capacity probe: {capacity_qps:.1f} qps "
+           f"({len(svc_warm)} warm responses)")
+
+    offered_qps = 1.2 * capacity_qps
+    svc = InfluenceService(engine=engine, config=ServeConfig(
+        max_batch=max_batch, max_queue=2 * max_batch))
+    reqs = [draw() for _ in range(n_req)]
+    responses = []
+    t_start = time.perf_counter()
+    submitted = 0
+    while submitted < n_req or svc.queue_depth:
+        now = time.perf_counter() - t_start
+        while submitted < n_req and submitted / offered_qps <= now:
+            r = svc.submit(reqs[submitted])
+            submitted += 1
+            if r is not None:
+                responses.append(r)
+        if svc.queue_depth >= max_batch or submitted >= n_req:
+            responses.extend(svc.drain())
+        else:
+            time.sleep(min(1.0 / offered_qps, 0.002))
+    wall = time.perf_counter() - t_start
+    roll = svc.rollup()
+
+    unreasoned = sum(1 for r in responses if not r.ok and not r.reason)
+    out = {
+        "metric": "fia-serve sustained qps (open loop @1.2x capacity)",
+        "value": round(roll["ok"] / wall, 2),
+        "unit": "queries/sec",
+        "details": {
+            "backend": jax.default_backend(),
+            "capacity_probe_qps": round(capacity_qps, 2),
+            "offered_qps": round(offered_qps, 2),
+            "requests": n_req,
+            "ok": roll["ok"],
+            "rejected": roll["rejected"],
+            "dropped_unreasoned": unreasoned,
+            "hot_hit_rate": roll["hot_hit_rate"],
+            "tiers": roll["tiers"],
+            "queue_wait_ms": roll["queue_wait_ms"],
+            "solve_ms": roll["solve_ms"],
+            "mean_batch_size": roll["mean_batch_size"],
+            "wall_s": round(wall, 2),
+        },
+    }
+    assert unreasoned == 0, "serving dropped requests without a reason"
+    print(json.dumps(out))
+
+
 if __name__ == "__main__":
-    main()
+    if "serve" in sys.argv[1:]:
+        serve_main()
+    else:
+        main()

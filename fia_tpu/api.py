@@ -13,6 +13,7 @@ wrapper is the migration path.
 from __future__ import annotations
 
 import os
+import weakref
 
 import jax
 import jax.numpy as jnp
@@ -24,6 +25,7 @@ from fia_tpu.influence.full import FullInfluenceEngine
 from fia_tpu.influence import grads as G
 from fia_tpu.influence.spectral import extreme_eigvals
 from fia_tpu.models import MODELS
+from fia_tpu.reliability.policy import FULL_SOLVERS, resolve_solver
 from fia_tpu.train import checkpoint
 from fia_tpu.train.trainer import Trainer, TrainConfig, TrainState
 
@@ -82,6 +84,10 @@ class FIAModel:
         # params/train-set changes; keeping every configuration alive
         # preserves its compiled queries across a solver sweep
         self._engines: dict = {}
+        # serving layers built over this model (fia_tpu.serve); weak so
+        # a dropped service doesn't pin its caches, but a live one is
+        # told when the state it cached against is gone
+        self._serving = weakref.WeakSet()
 
     # -- properties --------------------------------------------------------
     @property
@@ -96,19 +102,39 @@ class FIAModel:
         return os.path.join(self.train_dir, f"{self.model_name}-checkpoint-{step}")
 
     def engine(self, solver: str | None = None, **extra) -> InfluenceEngine:
-        key = (solver or self.solver, tuple(sorted(extra.items())))
+        name = resolve_solver(solver, default=self.solver)
+        key = (name, tuple(sorted(extra.items())))
         eng = self._engines.get(key)
         if eng is None:
             eng = self._engines[key] = InfluenceEngine(
                 self.model, self.state.params, self.data_sets["train"],
-                damping=self.damping, solver=solver or self.solver,
+                damping=self.damping, solver=name,
                 cache_dir=self.train_dir, model_name=self.model_name,
                 mesh=self.mesh, **extra,
             )
         return eng
 
     def _invalidate(self):
+        """Every derived-state holder learns the params/train set moved:
+        engines are dropped (rebuilt lazily from the new state) and any
+        serving layer clears its hot caches and memoized fingerprints."""
         self._engines.clear()
+        for svc in list(self._serving):
+            svc.invalidate()
+
+    def _register_serving(self, svc) -> None:
+        self._serving.add(svc)
+
+    def serve(self, config=None, solver: str | None = None, **engine_extra):
+        """An online query service over this model
+        (:class:`fia_tpu.serve.InfluenceService`). The service tracks
+        this model: retrain/checkpoint-load/train-set mutation
+        invalidates its caches automatically."""
+        from fia_tpu.serve import InfluenceService
+
+        return InfluenceService.from_model(
+            self, config=config, solver=solver, **engine_extra
+        )
 
     # -- training (genericNeuralNet.py:367-449) ----------------------------
     def train(self, num_steps: int, iter_to_switch_to_batch: int | None = None,
@@ -225,11 +251,20 @@ class FIAModel:
         u, i = self.data_sets["test"].x[test_index[0]]
         return self.model.extract_block(self.state.params, int(u), int(i))
 
-    def get_inverse_hvp(self, v, approx_type="cg", approx_params=None):
-        """Full-parameter inverse HVP (genericNeuralNet.py:503-508)."""
+    def get_inverse_hvp(self, v, approx_type=None, approx_params=None):
+        """Full-parameter inverse HVP (genericNeuralNet.py:503-508).
+
+        ``approx_type=None`` adopts the model's configured solver (the
+        reference hardcoded CG here while every other path honoured the
+        ctor solver); either way the name resolves through the one
+        ladder-aware path, mapped onto what the full-parameter engine
+        supports (``direct`` has no full-Hessian rung → CG).
+        """
         full = FullInfluenceEngine(
             self.model, self.state.params, self.data_sets["train"],
-            damping=self.damping, solver=approx_type, mesh=self.mesh,
+            damping=self.damping, mesh=self.mesh,
+            solver=resolve_solver(approx_type, default=self.solver,
+                                  supported=FULL_SOLVERS),
             **(approx_params or {}),
         )
         return full.get_inverse_hvp(v)

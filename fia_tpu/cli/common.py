@@ -143,10 +143,17 @@ def base_parser(desc: str) -> argparse.ArgumentParser:
 
 
 def engine_kwargs(args) -> dict:
-    """Solver/impl engine kwargs shared by every driver."""
+    """Solver/impl engine kwargs shared by every driver.
+
+    The solver name routes through the one ladder-aware resolution path
+    (reliability/policy.resolve_solver) — the same call api.FIAModel
+    makes — so a CLI run and the library agree on what a configured
+    solver means."""
+    from fia_tpu.reliability.policy import resolve_solver
+
     return dict(
         damping=args.damping,
-        solver=args.solver,
+        solver=resolve_solver(args.solver),
         pad_policy=args.pad_policy,
         cg_tol=cg_tol_for(args),
         cg_maxiter=args.cg_maxiter,

@@ -1,0 +1,209 @@
+"""Online influence-query serving driver.
+
+Turns a trained model into a stdin/stdout JSONL service backed by
+:class:`fia_tpu.serve.InfluenceService`: one request object per input
+line (``{"user": u, "item": i, "id": ..., "deadline_s": ...}`` — bare
+``u i`` pairs are accepted too), one response object per output line
+(the ``serve.request`` schema of fia_tpu/serve/metrics.py plus the
+score payload).
+
+Modes (mutually exclusive, checked in this order):
+
+- ``--warmup N``: plan and dispatch the micro-batches the scheduler
+  would build for N representative test points (the pad-bucket ladder),
+  print the compiled program keys, and exit. Run it before pointing
+  traffic at a fresh process — the first query of a cold bucket
+  otherwise pays its compile inside someone's latency budget.
+- ``--smoke_requests N``: self-contained synthetic open-loop stream — N
+  queries over the test split with a repeat-heavy hot set — then a
+  latency/cache report. Exits nonzero unless every request either
+  succeeded or was rejected with a classified reason, and the hot tier
+  actually absorbed repeats. This is the CI gate (``make serve-smoke``).
+- default: the stdin loop, draining after every ``--drain_every`` lines
+  (micro-batching needs a queue; a pipe full of requests provides one).
+
+Run:  python -m fia_tpu.cli.serve --dataset synthetic --model MF \
+        --num_steps_train 300 --warmup 32
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+import numpy as np
+
+from fia_tpu.cli import common
+from fia_tpu.influence.engine import InfluenceEngine
+from fia_tpu.serve import InfluenceService, Request, ServeConfig
+
+
+def add_serve_flags(p):
+    p.add_argument("--max_batch", type=int, default=32,
+                   help="micro-batch coalescing cap per device dispatch")
+    p.add_argument("--max_queue", type=int, default=256,
+                   help="admission bound: queued requests beyond this "
+                        "are rejected with reason 'overload'")
+    p.add_argument("--cache_entries", type=int, default=1024,
+                   help="hot-block LRU capacity (solved (u,i) blocks)")
+    p.add_argument("--coalesce", choices=["bucket", "fifo"],
+                   default="bucket",
+                   help="dispatch order: pad-bucket sorted or arrival")
+    p.add_argument("--request_deadline", type=float, default=0.0,
+                   help="default per-request budget in seconds "
+                        "(0 = unbounded); expired requests are rejected "
+                        "with reason 'deadline'")
+    p.add_argument("--disk_cache", type=int, default=1,
+                   help="1: verified on-disk tier under --train_dir")
+    p.add_argument("--metrics", type=str, default="auto",
+                   help="serving metrics JSONL path; 'auto' derives one "
+                        "under --train_dir, 'none' disables")
+    p.add_argument("--drain_every", type=int, default=32,
+                   help="stdin mode: drain the queue every N lines")
+    p.add_argument("--warmup", type=int, default=0,
+                   help="precompile the bucket ladder over N test "
+                        "points, report, exit")
+    p.add_argument("--smoke_requests", type=int, default=0,
+                   help="run an N-request synthetic smoke stream, "
+                        "report, exit (nonzero on failure)")
+    p.add_argument("--smoke_hot_frac", type=float, default=0.5,
+                   help="smoke stream: fraction of requests drawn from "
+                        "a small hot set of repeated queries")
+    return p
+
+
+def build_service(args):
+    """Model + engine + service from the shared CLI plumbing."""
+    common.apply_backend(args)
+    splits = common.load_splits(args)
+    model, params = common.build_model(args, splits)
+    name = common.model_name_for(args, splits=splits)
+    _, state, _ = common.train_or_load(args, model, params, splits,
+                                       verbose=False)
+    engine = InfluenceEngine(
+        model, state.params, splits["train"],
+        cache_dir=args.train_dir, model_name=name,
+        mesh=common.mesh_for(args), **common.engine_kwargs(args),
+    )
+    metrics = args.metrics
+    if metrics == "none":
+        metrics = None
+    elif metrics == "auto":
+        import os
+
+        metrics = os.path.join(
+            args.train_dir, f"serve-{args.model}-{args.dataset}.jsonl"
+        )
+    cfg = ServeConfig(
+        max_batch=args.max_batch, max_queue=args.max_queue,
+        cache_entries=args.cache_entries, coalesce=args.coalesce,
+        default_deadline_s=args.request_deadline or None,
+        disk_cache=bool(args.disk_cache), metrics_path=metrics,
+    )
+    svc = InfluenceService(engine=engine, config=cfg)
+    return svc, splits
+
+
+def parse_request(line: str) -> Request | None:
+    """One stdin line → Request (JSON object or bare ``u i``), None on
+    blank lines."""
+    line = line.strip()
+    if not line:
+        return None
+    if line.startswith("{"):
+        d = json.loads(line)
+        return Request(user=int(d["user"]), item=int(d["item"]),
+                       id=d.get("id"), deadline_s=d.get("deadline_s"))
+    parts = line.split()
+    return Request(user=int(parts[0]), item=int(parts[1]))
+
+
+def smoke_stream(test_x, n: int, hot_frac: float, seed: int):
+    """A repeat-heavy synthetic request stream over the test split:
+    ``hot_frac`` of requests revisit a small hot set (what a real
+    serving workload looks like, and what makes hot-tier hits
+    assertable)."""
+    rng = np.random.default_rng(seed)
+    hot = test_x[rng.choice(len(test_x), size=max(4, n // 25),
+                            replace=False)]
+    out = []
+    for k in range(n):
+        if rng.random() < hot_frac:
+            u, i = hot[rng.integers(len(hot))]
+        else:
+            u, i = test_x[rng.integers(len(test_x))]
+        out.append(Request(user=int(u), item=int(i), id=f"smoke{k}"))
+    return out
+
+
+def run_smoke(svc: InfluenceService, splits, args) -> int:
+    reqs = smoke_stream(np.asarray(splits["test"].x), args.smoke_requests,
+                        args.smoke_hot_frac, args.seed)
+    responses = svc.run(reqs, drain_every=args.max_batch)
+    report = svc.close()
+    print(json.dumps({"event": "serve.smoke", **report}))
+
+    failures = []
+    unreasoned = [r for r in responses
+                  if not r.ok and not r.reason]
+    unresolved = len(reqs) - len(responses)
+    if unreasoned or unresolved:
+        failures.append(
+            f"{len(unreasoned)} rejected without reason, "
+            f"{unresolved} never resolved"
+        )
+    if svc.cache.stats.hits_hot <= 0:
+        failures.append("hot-block cache never hit on a repeat-heavy "
+                        "stream")
+    if report["ok"] + sum(report["rejected"].values()) != len(reqs):
+        failures.append("request accounting does not add up")
+    for f in failures:
+        print(f"SMOKE FAIL: {f}", file=sys.stderr)
+    if not failures:
+        print(f"serve smoke ok: {report['ok']}/{len(reqs)} served, "
+              f"hot hits {svc.cache.stats.hits_hot}, "
+              f"p95 solve {report['solve_ms']['p95']}ms")
+    return 1 if failures else 0
+
+
+def run_warmup(svc: InfluenceService, splits, args) -> int:
+    pts = np.asarray(splits["test"].x[: args.warmup], np.int64)
+    info = svc.warmup(pts)
+    print(json.dumps({"event": "serve.warmup", **info}))
+    return 0
+
+
+def run_stdin(svc: InfluenceService, args) -> int:
+    n = 0
+    for line in sys.stdin:
+        req = parse_request(line)
+        if req is None:
+            continue
+        r = svc.submit(req)
+        if r is not None:  # immediate rejection
+            print(json.dumps(r.json()), flush=True)
+        n += 1
+        if args.drain_every and n % args.drain_every == 0:
+            for resp in svc.drain():
+                print(json.dumps(resp.json()), flush=True)
+    for resp in svc.drain():
+        print(json.dumps(resp.json()), flush=True)
+    report = svc.close()
+    print(json.dumps({"event": "serve.rollup.final", **report}),
+          file=sys.stderr)
+    return 0
+
+
+def main(argv=None) -> int:
+    p = add_serve_flags(common.base_parser(__doc__))
+    args = p.parse_args(argv)
+    svc, splits = build_service(args)
+    if args.warmup:
+        return run_warmup(svc, splits, args)
+    if args.smoke_requests:
+        return run_smoke(svc, splits, args)
+    return run_stdin(svc, args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

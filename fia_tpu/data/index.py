@@ -13,6 +13,8 @@ datasets; the numpy path is the default and is already vectorised.
 
 from __future__ import annotations
 
+from collections import OrderedDict
+
 import numpy as np
 
 from fia_tpu.data import native
@@ -54,6 +56,21 @@ class InteractionIndex:
         self.num_items = int(num_items if num_items is not None else x[:, 1].max() + 1)
         self._u_indptr, self._u_rows = _csr_from_ids(x[:, 0], self.num_users)
         self._i_indptr, self._i_rows = _csr_from_ids(x[:, 1], self.num_items)
+        # related() concatenation memo: a serving stream revisits hot
+        # (u, i) pairs, and each visit re-allocated the concatenated
+        # postings (the engine itself calls related() when attaching
+        # result rows). Bounded LRU; entries are write-protected views
+        # handed to multiple callers, so a consumer cannot corrupt them.
+        self._related_memo: OrderedDict[tuple, np.ndarray] = OrderedDict()
+        self._related_memo_cap = 4096
+        self.memo_hits = 0
+        self.memo_misses = 0
+        # single-query related_padded memo: the online service pads the
+        # same hot (u, i) pair to the same bucket over and over — the
+        # (idx, mask) build is the per-query host cost on the padded
+        # path. Keyed by pair + resolved pad so a bucket change misses.
+        self._padded_memo: OrderedDict[tuple, tuple] = OrderedDict()
+        self._padded_memo_cap = 1024
 
     def rows_of_user(self, u: int) -> np.ndarray:
         return self._u_rows[self._u_indptr[u] : self._u_indptr[u + 1]]
@@ -67,8 +84,24 @@ class InteractionIndex:
         Like the reference (``matrix_factorization.py:315-322``), rows
         matching both (the (u, i) interaction itself, if present in the
         training set) appear twice — user rows first, then item rows.
+
+        Memoized (bounded LRU, read-only arrays): repeated queries for
+        the same pair — the serving hot set — skip the concatenation.
         """
-        return np.concatenate([self.rows_of_user(u), self.rows_of_item(i)])
+        key = (int(u), int(i))
+        memo = self._related_memo
+        hit = memo.get(key)
+        if hit is not None:
+            memo.move_to_end(key)
+            self.memo_hits += 1
+            return hit
+        self.memo_misses += 1
+        out = np.concatenate([self.rows_of_user(u), self.rows_of_item(i)])
+        out.setflags(write=False)
+        memo[key] = out
+        if len(memo) > self._related_memo_cap:
+            memo.popitem(last=False)
+        return out
 
     def related_count(self, u: int, i: int) -> int:
         return int(
@@ -122,6 +155,15 @@ class InteractionIndex:
           count: (T,)   int32 — true related-set sizes.
         """
         test_points = np.asarray(test_points)
+        if len(test_points) == 1:
+            u, i = (int(v) for v in test_points[0])
+            pad = bucketed_pad(self.related_count(u, i), bucket, pad_to)
+            key = (u, i, pad)
+            hit = self._padded_memo.get(key)
+            if hit is not None:
+                self._padded_memo.move_to_end(key)
+                self.memo_hits += 1
+                return hit
         lists = [self.related(int(u), int(i)) for u, i in test_points]
         counts = np.array([len(l) for l in lists], dtype=np.int32)
         pad_to = bucketed_pad(counts.max() if counts.size else 1, bucket, pad_to)
@@ -130,4 +172,13 @@ class InteractionIndex:
         for t, l in enumerate(lists):
             idx[t, : len(l)] = l
             mask[t, : len(l)] = True
+        for a in (idx, mask, counts):
+            a.setflags(write=False)
+        if len(test_points) == 1:
+            self._padded_memo[(int(test_points[0][0]),
+                               int(test_points[0][1]), pad_to)] = (
+                idx, mask, counts
+            )
+            if len(self._padded_memo) > self._padded_memo_cap:
+                self._padded_memo.popitem(last=False)
         return idx, mask, counts

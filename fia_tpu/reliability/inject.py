@@ -37,6 +37,8 @@ Sites currently wired (grep for ``inject.fire``/``inject.corrupt``/
 ``artifacts.publish``       generic artifact publish (``damage`` kinds)
 ``checkpoint.publish``      one rotated/terminal checkpoint publish
 ``engine.cache_publish``    one inverse-HVP cache entry publish
+``serve.dispatch``          one micro-batch device dispatch in the service
+``serve.cache_publish``     one serving-tier disk cache entry publish
 ==========================  ================================================
 
 On-disk corruption kinds (fired through :func:`damage`, applied AFTER a
@@ -110,7 +112,9 @@ class Fault:
     ``corrupt`` there).
     ``kind``: a taxonomy kind — ``oom`` / ``ambiguous`` / ``worker`` /
     ``preemption`` raise a RuntimeError carrying the observed signature,
-    ``host_oom`` raises :class:`MemoryError`, ``nan`` corrupts the
+    ``host_oom`` raises :class:`MemoryError`, ``deadline`` raises
+    :class:`~fia_tpu.reliability.taxonomy.DeadlineExpired` (a budget
+    expiring mid-dispatch), ``nan`` corrupts the
     payload passed through :func:`corrupt` (it never raises) — or an
     artifact kind (``torn`` / ``bitflip`` / ``stale_manifest``) that
     mutates the on-disk file passed through :func:`damage`.
@@ -157,6 +161,10 @@ class Injector:
         self.log.append((site, idx, f.kind))
         if f.kind == taxonomy.HOST_OOM:
             raise MemoryError(f.message or "injected host allocation failure")
+        if f.kind == taxonomy.DEADLINE:
+            raise taxonomy.DeadlineExpired(
+                f.message or f"injected deadline expiry at {site}"
+            )
         msg = f.message or MESSAGES.get(f.kind)
         if msg is None:
             raise ValueError(f"no synthetic signature for kind {f.kind!r}")

@@ -154,3 +154,39 @@ def next_solver(
     """The next (more robust) rung under ``current``, or ``None`` at
     the ladder's bottom."""
     return fallback.get(current)
+
+
+# Solver names each engine accepts (ladder-ordered robust-last).
+BLOCK_SOLVERS = ("lissa", "schulz", "cg", "direct")
+FULL_SOLVERS = ("lissa", "cg")
+
+
+def resolve_solver(
+    requested: str | None,
+    default: str = "direct",
+    supported: tuple[str, ...] = BLOCK_SOLVERS,
+) -> str:
+    """The ONE solver-resolution path (api / CLI / serving all route
+    here, so a model's configured solver means the same thing
+    everywhere).
+
+    ``requested=None`` resolves to ``default``. A solver the target
+    engine does not support (e.g. ``direct`` on the full-parameter
+    engine, whose block Hessian cannot be materialised) walks the
+    degradation ladder upward until a supported rung is found, bottoming
+    out at the most robust supported solver — never a ValueError deep in
+    an engine constructor.
+    """
+    name = default if requested is None else str(requested)
+    seen = set()
+    while name not in supported:
+        if name in seen:  # ladder cycle guard (config maps are data)
+            break
+        seen.add(name)
+        nxt = next_solver(name)
+        if nxt is None:
+            break
+        name = nxt
+    if name not in supported:
+        name = supported[-1]
+    return name

@@ -1,0 +1,33 @@
+"""Online influence-query serving.
+
+The offline drivers (``cli/rq1.py``, ``cli/rq2.py``) answer influence
+queries in one-shot experiment sweeps; this package turns the engine
+into a *service*: a stream of ``(user, item)`` requests answered under
+a latency budget, with micro-batching to amortize compilation and
+device transfers, a hot-block cache over per-query iHVP results, and
+admission control so overload sheds load deterministically instead of
+OOMing (docs/design.md §12).
+
+Layers (each its own module, composable without the service):
+
+- :mod:`fia_tpu.serve.request`   — request/response records.
+- :mod:`fia_tpu.serve.cache`     — bounded in-memory hot-block LRU and
+  the verified on-disk tier beneath it (reliability/artifacts.py).
+- :mod:`fia_tpu.serve.scheduler` — the micro-batching planner.
+- :mod:`fia_tpu.serve.admission` — queue-depth/deadline admission.
+- :mod:`fia_tpu.serve.metrics`   — per-request JSONL events + rollups.
+- :mod:`fia_tpu.serve.service`   — :class:`InfluenceService`, the event
+  loop tying the above to an :class:`InfluenceEngine`.
+"""
+
+from fia_tpu.serve.admission import (  # noqa: F401
+    REASON_DEADLINE,
+    REASON_INVALID,
+    REASON_OVERLOAD,
+    AdmissionController,
+)
+from fia_tpu.serve.cache import CacheStats, HotBlockCache  # noqa: F401
+from fia_tpu.serve.metrics import ServeMetrics  # noqa: F401
+from fia_tpu.serve.request import Request, Response  # noqa: F401
+from fia_tpu.serve.scheduler import MicroBatcher  # noqa: F401
+from fia_tpu.serve.service import InfluenceService, ServeConfig  # noqa: F401

@@ -1,0 +1,74 @@
+"""Admission control: overload sheds load deterministically.
+
+The alternative to admission control on an accelerator-backed service
+is not slowness, it is death: an unbounded queue turns a traffic burst
+into unbounded host memory plus ever-larger coalesced batches, and the
+engine's own memory envelope (docs/design.md §9b) then learns failure
+ceilings from load spikes rather than real capacity. The controller
+bounds the queue and stamps every rejection with a classified reason,
+reusing the reliability failure taxonomy where one applies
+(``deadline``) and serve-specific reasons otherwise (``overload``,
+``invalid``) — "dropped without reason" is a bug class the smoke test
+asserts against.
+
+Decisions are a pure function of (request, queue depth, clock), so a
+replayed request stream sheds exactly the same requests.
+"""
+
+from __future__ import annotations
+
+from fia_tpu.reliability import taxonomy
+from fia_tpu.serve.request import Request, Ticket
+
+# Rejection reasons. DEADLINE is the taxonomy kind (a request whose
+# budget expired is the same failure class as a Deadline-guarded
+# workload stopping); the others are admission-specific.
+REASON_DEADLINE = taxonomy.DEADLINE
+REASON_OVERLOAD = "overload"
+REASON_INVALID = "invalid"
+
+
+class AdmissionController:
+    """Bounded-queue, deadline-aware admission.
+
+    ``max_queue``: tickets allowed to wait; a submit finding the queue
+    full is rejected (newest-sheds — deterministic, and the queued work
+    keeps its arrival-order latency bound).
+    ``default_deadline_s``: budget stamped on requests that carry none
+    (None = unbounded).
+    ``num_users``/``num_items``: id-range validation — an out-of-range
+    id must be refused at the door, not discovered as a host-side
+    IndexError inside a coalesced batch dispatch.
+    """
+
+    def __init__(self, max_queue: int = 256,
+                 default_deadline_s: float | None = None,
+                 num_users: int | None = None,
+                 num_items: int | None = None):
+        self.max_queue = max(int(max_queue), 1)
+        self.default_deadline_s = default_deadline_s
+        self.num_users = num_users
+        self.num_items = num_items
+
+    def reject_reason(self, req: Request, queue_depth: int) -> str | None:
+        """The rejection reason for ``req`` at ``queue_depth``, or None
+        when it is admitted."""
+        u, i = int(req.user), int(req.item)
+        if u < 0 or i < 0:
+            return REASON_INVALID
+        if self.num_users is not None and u >= self.num_users:
+            return REASON_INVALID
+        if self.num_items is not None and i >= self.num_items:
+            return REASON_INVALID
+        if queue_depth >= self.max_queue:
+            return REASON_OVERLOAD
+        return None
+
+    def ticket(self, req: Request, now: float) -> Ticket:
+        """An admitted request's queue ticket (absolute deadline on the
+        service clock)."""
+        budget = req.deadline_s
+        if budget is None:
+            budget = self.default_deadline_s
+        t_deadline = None if budget is None or budget <= 0 else now + budget
+        return Ticket(req=req, t_arrival=now, t_deadline=t_deadline)

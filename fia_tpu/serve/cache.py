@@ -1,0 +1,201 @@
+"""Hot-block caching for the serving layer.
+
+Two tiers above the engine's device compute:
+
+- :class:`HotBlockCache` — a bounded in-memory LRU over per-(user,
+  item) solved blocks (iHVP, test-side vector, unpadded scores). Keys
+  fold in the engine's params fingerprint digest and solver name, so a
+  retrained/mutated model can never serve a stale entry even if a
+  caller forgets to invalidate (api.FIAModel._invalidate also clears
+  derived services explicitly — belt and braces).
+- the on-disk tier — verified npz entries under
+  ``<cache_dir>/serve/``, published and read through the artifact
+  integrity layer (:mod:`fia_tpu.reliability.artifacts`): fsync'd
+  atomic publish with a checksummed manifest carrying the same
+  fingerprint, verify-on-read with quarantine-to-``*.corrupt`` on
+  damage — a torn or bit-rotted entry is a clean miss, never poison.
+
+Entry payloads are plain numpy arrays, write-protected before they
+enter the hot tier so a consumer mutating a response cannot corrupt
+later hits.
+"""
+
+from __future__ import annotations
+
+import os
+from collections import OrderedDict
+from dataclasses import dataclass, field
+
+import numpy as np
+
+
+@dataclass
+class CacheStats:
+    hits_hot: int = 0
+    hits_disk: int = 0
+    misses: int = 0
+    evictions: int = 0
+    invalidations: int = 0
+    disk_rejects: int = 0  # corrupt/foreign disk entries refused
+
+    def json(self) -> dict:
+        return dict(self.__dict__)
+
+
+@dataclass
+class BlockEntry:
+    """One solved (user, item) block: everything a Response needs."""
+
+    scores: np.ndarray  # (count,) unpadded related scores
+    ihvp: np.ndarray  # (d,)
+    test_grad: np.ndarray  # (d,)
+    count: int
+    extra: dict = field(default_factory=dict)
+
+    def freeze(self) -> "BlockEntry":
+        for a in (self.scores, self.ihvp, self.test_grad):
+            a.setflags(write=False)
+        return self
+
+    @property
+    def nbytes(self) -> int:
+        return self.scores.nbytes + self.ihvp.nbytes + self.test_grad.nbytes
+
+
+class HotBlockCache:
+    """Bounded LRU over solved blocks, keyed on
+    ``(params_fp_digest, solver, user, item)``.
+
+    ``capacity_entries`` bounds the entry count; ``capacity_bytes``
+    (optional) additionally bounds the payload footprint — eviction is
+    strictly LRU under whichever bound binds first, so the shed set for
+    a given access sequence is deterministic.
+    """
+
+    def __init__(self, capacity_entries: int = 1024,
+                 capacity_bytes: int | None = None):
+        self.capacity_entries = max(int(capacity_entries), 0)
+        self.capacity_bytes = capacity_bytes
+        self.stats = CacheStats()
+        self._entries: OrderedDict[tuple, BlockEntry] = OrderedDict()
+        self._nbytes = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    @property
+    def nbytes(self) -> int:
+        return self._nbytes
+
+    def get(self, key: tuple) -> BlockEntry | None:
+        e = self._entries.get(key)
+        if e is None:
+            self.stats.misses += 1
+            return None
+        self._entries.move_to_end(key)
+        self.stats.hits_hot += 1
+        return e
+
+    def peek(self, key: tuple) -> BlockEntry | None:
+        """Lookup without touching recency or the hit/miss counters."""
+        return self._entries.get(key)
+
+    def put(self, key: tuple, entry: BlockEntry) -> None:
+        if self.capacity_entries == 0:
+            return
+        entry.freeze()
+        old = self._entries.pop(key, None)
+        if old is not None:
+            self._nbytes -= old.nbytes
+        self._entries[key] = entry
+        self._nbytes += entry.nbytes
+        while len(self._entries) > self.capacity_entries or (
+            self.capacity_bytes is not None
+            and self._nbytes > self.capacity_bytes
+            and len(self._entries) > 1
+        ):
+            _, ev = self._entries.popitem(last=False)
+            self._nbytes -= ev.nbytes
+            self.stats.evictions += 1
+
+    def invalidate(self) -> None:
+        self.stats.invalidations += 1
+        self._entries.clear()
+        self._nbytes = 0
+
+
+# -- on-disk tier ----------------------------------------------------------
+
+def disk_entry_path(cache_dir: str, model_name: str, solver: str,
+                    user: int, item: int) -> str:
+    """Path of one serving-tier disk entry under ``cache_dir``.
+
+    Keyed like the engine's reference-shaped iHVP cache (model name +
+    solver in the filename) plus the query pair; the params fingerprint
+    lives in the manifest, not the name — a retrain overwrites the
+    entry in place rather than accumulating dead generations.
+    """
+    return os.path.join(
+        cache_dir, "serve",
+        f"{model_name}-{solver}-u{int(user)}-i{int(item)}.npz",
+    )
+
+
+def disk_fingerprint(model_name: str, solver: str, fp_digest: str) -> dict:
+    return {
+        "kind": "serve-block",
+        "model_key": model_name,
+        "solver": solver,
+        "params_fp": fp_digest,
+    }
+
+
+def disk_get(path: str, fingerprint: dict,
+             stats: CacheStats | None = None) -> BlockEntry | None:
+    """Verified read of a disk-tier entry; any integrity or fingerprint
+    failure is a miss (corrupt classes are quarantined by load_npz)."""
+    from fia_tpu.reliability import artifacts
+
+    if not os.path.exists(path):
+        return None
+    try:
+        d = artifacts.load_npz(
+            path, expected_fingerprint=fingerprint, require_manifest=True
+        )
+    except artifacts.ArtifactIntegrityError:
+        if stats is not None:
+            stats.disk_rejects += 1
+        return None
+    try:
+        return BlockEntry(
+            scores=np.asarray(d["scores"]),
+            ihvp=np.asarray(d["ihvp"]),
+            test_grad=np.asarray(d["test_grad"]),
+            count=int(d["count"]),
+        ).freeze()
+    except KeyError:
+        if stats is not None:
+            stats.disk_rejects += 1
+        return None
+
+
+def disk_put(path: str, entry: BlockEntry, fingerprint: dict) -> None:
+    """Publish a disk-tier entry through the integrity layer.
+
+    ``serve.cache_publish`` is the fault-injection site: the damage
+    channel corrupts exactly this generation after the (honest) atomic
+    publish, so tests exercise the read-side verification above.
+    """
+    from fia_tpu.reliability import artifacts
+
+    artifacts.publish_npz(
+        path,
+        dict(
+            scores=np.asarray(entry.scores),
+            ihvp=np.asarray(entry.ihvp),
+            test_grad=np.asarray(entry.test_grad),
+            count=np.asarray(entry.count, np.int64),
+        ),
+        fingerprint=fingerprint,
+        site="serve.cache_publish",
+    )

@@ -1,0 +1,117 @@
+"""Serving metrics: per-request JSONL events and latency rollups.
+
+Schema (one JSON object per line, via utils/logging.EventLog — every
+record carries ``t``, a wall-clock epoch-seconds stamp):
+
+``serve.request`` — one line per finished request::
+
+    {"t": ..., "event": "serve.request", "id": ..., "user": u,
+     "item": i, "status": "ok"|"rejected", "reason": null|"deadline"|
+     "overload"|"invalid"|<taxonomy kind>, "tier": null|"hot"|"disk"|
+     "compute", "queue_wait_ms": f, "solve_ms": f,
+     "batch_id": n|null, "batch_size": n|null}
+
+``serve.batch`` — one line per micro-batch dispatch::
+
+    {"event": "serve.batch", "batch_id": n, "size": n,
+     "total_rows": n, "solve_ms": f, "status": "ok"|<reason>}
+
+``serve.rollup`` — the aggregate summary (also returned by
+:meth:`ServeMetrics.rollup`)::
+
+    {"event": "serve.rollup", "requests": n, "ok": n,
+     "rejected": {reason: n}, "tiers": {tier: n}, "hot_hit_rate": f,
+     "queue_wait_ms": {"p50": f, "p95": f, "max": f},
+     "solve_ms": {"p50": f, "p95": f, "max": f},
+     "batches": n, "mean_batch_size": f, "cache": {...}}
+
+``scripts/latency_report.py`` renders a human report from these lines;
+the schema is the stable surface operators build dashboards on.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from fia_tpu.serve.request import Response
+from fia_tpu.utils.logging import EventLog
+
+
+def _pcts(values: list[float]) -> dict:
+    if not values:
+        return {"p50": 0.0, "p95": 0.0, "max": 0.0}
+    a = np.asarray(values, np.float64)
+    return {
+        "p50": round(float(np.percentile(a, 50)), 3),
+        "p95": round(float(np.percentile(a, 95)), 3),
+        "max": round(float(a.max()), 3),
+    }
+
+
+class ServeMetrics:
+    """Accumulates per-request records and mirrors them to JSONL.
+
+    ``path``: JSONL file (falsy disables the file, rollups still work).
+    """
+
+    def __init__(self, path: str | None = None):
+        self.log = EventLog(path)
+        self.queue_wait_ms: list[float] = []
+        self.solve_ms: list[float] = []
+        self.by_status: dict[str, int] = {}
+        self.by_reason: dict[str, int] = {}
+        self.by_tier: dict[str, int] = {}
+        self.batch_sizes: list[int] = []
+
+    def record_request(self, resp: Response) -> None:
+        self.by_status[resp.status] = self.by_status.get(resp.status, 0) + 1
+        if resp.reason:
+            self.by_reason[resp.reason] = (
+                self.by_reason.get(resp.reason, 0) + 1
+            )
+        if resp.cache_tier:
+            self.by_tier[resp.cache_tier] = (
+                self.by_tier.get(resp.cache_tier, 0) + 1
+            )
+        if resp.ok:
+            self.queue_wait_ms.append(resp.queue_wait_s * 1e3)
+            self.solve_ms.append(resp.solve_s * 1e3)
+        self.log.log("serve.request", **resp.json(include_payload=False))
+
+    def record_batch(self, batch_id: int, size: int, total_rows: int,
+                     solve_s: float, status: str = "ok") -> None:
+        self.batch_sizes.append(int(size))
+        self.log.log(
+            "serve.batch", batch_id=batch_id, size=int(size),
+            total_rows=int(total_rows),
+            solve_ms=round(solve_s * 1e3, 3), status=status,
+        )
+
+    def rollup(self, cache_stats: dict | None = None) -> dict:
+        n = sum(self.by_status.values())
+        hot = self.by_tier.get("hot", 0)
+        served = sum(self.by_tier.values())
+        out = {
+            "requests": n,
+            "ok": self.by_status.get("ok", 0),
+            "rejected": dict(self.by_reason),
+            "tiers": dict(self.by_tier),
+            "hot_hit_rate": round(hot / served, 4) if served else 0.0,
+            "queue_wait_ms": _pcts(self.queue_wait_ms),
+            "solve_ms": _pcts(self.solve_ms),
+            "batches": len(self.batch_sizes),
+            "mean_batch_size": round(
+                float(np.mean(self.batch_sizes)), 2
+            ) if self.batch_sizes else 0.0,
+        }
+        if cache_stats is not None:
+            out["cache"] = dict(cache_stats)
+        return out
+
+    def log_rollup(self, cache_stats: dict | None = None) -> dict:
+        r = self.rollup(cache_stats)
+        self.log.log("serve.rollup", **r)
+        return r
+
+    def close(self) -> None:
+        self.log.close()

@@ -1,0 +1,100 @@
+"""Request/response records for the serving layer.
+
+A :class:`Request` is one ``(user, item)`` influence query plus its
+serving metadata (id, arrival time, optional per-request deadline). A
+:class:`Response` carries the answer — the unpadded related-row scores
+and the iHVP/test-grad block vectors — or a taxonomy-classified
+rejection, plus the per-request latency breakdown the metrics layer
+logs (queue wait, solve time, cache tier, batch placement).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+# Cache tiers a response can be served from. ``compute`` = this request
+# triggered (or rode) a device dispatch this drain; ``hot`` = in-memory
+# LRU hit (including duplicates coalesced within one drain); ``disk`` =
+# verified on-disk entry promoted into the hot tier.
+TIER_COMPUTE = "compute"
+TIER_HOT = "hot"
+TIER_DISK = "disk"
+
+STATUS_OK = "ok"
+STATUS_REJECTED = "rejected"
+
+
+@dataclass
+class Request:
+    """One influence query entering the service."""
+
+    user: int
+    item: int
+    id: str | None = None
+    # wall-clock budget in seconds, measured from arrival; None adopts
+    # the service default (ServeConfig.default_deadline_s)
+    deadline_s: float | None = None
+
+    def key(self) -> tuple[int, int]:
+        return (int(self.user), int(self.item))
+
+
+@dataclass
+class Ticket:
+    """A queued admitted request (service-internal)."""
+
+    req: Request
+    t_arrival: float
+    t_deadline: float | None  # absolute, on the service clock
+
+    def expired(self, now: float) -> bool:
+        return self.t_deadline is not None and now > self.t_deadline
+
+
+@dataclass
+class Response:
+    """The service's answer to one request."""
+
+    id: str | None
+    user: int
+    item: int
+    status: str = STATUS_OK
+    # taxonomy kind ("deadline", "oom", ...) or an admission reason
+    # ("overload", "invalid") when status == "rejected"
+    reason: str | None = None
+    scores: np.ndarray | None = None  # (count,) unpadded related scores
+    related: np.ndarray | None = None  # (count,) train-row ids
+    ihvp: np.ndarray | None = None  # (d,) block inverse-HVP
+    test_grad: np.ndarray | None = None  # (d,) test-side block vector
+    cache_tier: str | None = None
+    queue_wait_s: float = 0.0
+    solve_s: float = 0.0
+    batch_id: int | None = None
+    batch_size: int | None = None
+    extra: dict = field(default_factory=dict)
+
+    @property
+    def ok(self) -> bool:
+        return self.status == STATUS_OK
+
+    def json(self, include_payload: bool = True) -> dict:
+        """JSON-encodable form (the CLI's stdout line)."""
+        out = {
+            "id": self.id,
+            "user": int(self.user),
+            "item": int(self.item),
+            "status": self.status,
+            "reason": self.reason,
+            "tier": self.cache_tier,
+            "queue_wait_ms": round(self.queue_wait_s * 1e3, 3),
+            "solve_ms": round(self.solve_s * 1e3, 3),
+            "batch_id": self.batch_id,
+            "batch_size": self.batch_size,
+        }
+        if include_payload and self.scores is not None:
+            out["scores"] = np.asarray(self.scores).tolist()
+            if self.related is not None:
+                out["related"] = np.asarray(self.related).tolist()
+        return out

@@ -1,0 +1,68 @@
+"""Micro-batching: coalesce compatible queries into one dispatch.
+
+A single influence query is a tiny device program drowning in fixed
+costs (host→device transfer of the test point, dispatch RPC, result
+fetch); the engine's whole design is batch amortization
+(docs/design.md §2). The scheduler recovers that amortization for a
+*stream*: queued queries sharing one engine configuration are packed
+into batches of at most ``max_batch``.
+
+Two coalescing orders:
+
+- ``"bucket"`` (default): a *stable* sort by the query's padded-size
+  bucket (``data/index.py:bucketed_pad`` over its related count)
+  before chunking — queries landing in the same bucket share compiled
+  programs on the padded path, and on the flat path similar-degree
+  neighbours tighten the total-row buckets. The sort is stable, so
+  arrival order is preserved within a bucket and the plan is
+  deterministic for a given queue.
+- ``"fifo"``: strict arrival order (lowest queue-position jitter).
+
+The plan is pure (no engine calls): a list of batches over the caller's
+items, so the service can apply it to tickets and the warmup path can
+apply the SAME planner to a sample stream — the shapes warmup compiles
+are exactly the shapes serving will dispatch.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from fia_tpu.data.index import bucketed_pad
+
+
+class MicroBatcher:
+    def __init__(self, max_batch: int = 32, coalesce: str = "bucket",
+                 pad_bucket: int = 128):
+        if coalesce not in ("bucket", "fifo"):
+            raise ValueError(f"unknown coalesce policy {coalesce!r}")
+        self.max_batch = max(int(max_batch), 1)
+        self.coalesce = coalesce
+        self.pad_bucket = int(pad_bucket)
+
+    def order(self, counts: np.ndarray) -> np.ndarray:
+        """Dispatch order over queue positions (stable)."""
+        n = len(counts)
+        if self.coalesce == "fifo" or n <= 1:
+            return np.arange(n)
+        buckets = np.array(
+            [bucketed_pad(int(c), self.pad_bucket) for c in counts]
+        )
+        return np.argsort(buckets, kind="stable")
+
+    def plan(self, counts: np.ndarray) -> list[np.ndarray]:
+        """Batches of queue positions: the coalesced order chunked into
+        consecutive ``max_batch`` slices.
+
+        Chunking the *ordered stream* (rather than emitting one batch
+        per bucket) keeps batches full: a bucket with 3 queries rides
+        with its neighbour bucket instead of paying a 3-query dispatch.
+        It also makes the dispatch stream reproducible by
+        ``engine.query_many(points[order], batch_queries=max_batch)`` —
+        the byte-identity contract the serving tests pin.
+        """
+        order = self.order(np.asarray(counts))
+        return [
+            order[s: s + self.max_batch]
+            for s in range(0, len(order), self.max_batch)
+        ]

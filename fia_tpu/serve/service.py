@@ -1,0 +1,415 @@
+"""``InfluenceService`` — the online influence-query event loop.
+
+One synchronous, deterministic loop (no threads: determinism is a
+feature the reliability tests pin, and the engine's device dispatch is
+already async under the hood):
+
+1. :meth:`submit` runs admission (queue bound, id validation, deadline
+   stamping) and enqueues a ticket or returns an immediate rejection.
+2. :meth:`drain` resolves every queued ticket: expired deadlines are
+   rejected; hot-cache and verified disk-tier hits answer without
+   device work; the misses are de-duplicated, micro-batched by the
+   scheduler, and dispatched through ``engine.query_batch`` — one
+   compiled program per batch instead of one per query. Results fill
+   both cache tiers, then every ticket resolves from the hot tier (a
+   key repeated within one drain computes once and hits for the rest).
+3. A classified device/deadline failure during a batch dispatch rejects
+   exactly that batch's requests with the taxonomy kind as the reason
+   and the loop continues — overload and faults shed load
+   deterministically; unclassified failures surface.
+
+Byte-identity contract: for a given drain, the dispatch stream is the
+scheduler's coalesced order and batches are consecutive ``max_batch``
+chunks of it, so the admitted results are bit-identical to
+``engine.query_many(points[order], batch_queries=max_batch)`` —
+serving must not change answers (tests/test_serve.py pins this).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from fia_tpu.reliability import inject, taxonomy
+from fia_tpu.serve.admission import REASON_DEADLINE, AdmissionController
+from fia_tpu.serve import cache as scache
+from fia_tpu.serve.cache import BlockEntry, HotBlockCache
+from fia_tpu.serve.metrics import ServeMetrics
+from fia_tpu.serve.request import (
+    STATUS_REJECTED,
+    TIER_COMPUTE,
+    TIER_DISK,
+    TIER_HOT,
+    Request,
+    Response,
+    Ticket,
+)
+from fia_tpu.serve.scheduler import MicroBatcher
+
+
+@dataclass
+class ServeConfig:
+    """Service knobs (see module docstrings for the semantics)."""
+
+    max_batch: int = 32  # micro-batch coalescing cap
+    max_queue: int = 256  # admission: tickets allowed to wait
+    coalesce: str = "bucket"  # "bucket" | "fifo" dispatch order
+    default_deadline_s: float | None = None  # per-request budget
+    cache_entries: int = 1024  # hot-block LRU capacity
+    cache_bytes: int | None = None  # optional hot-tier byte bound
+    disk_cache: bool = True  # use cache_dir tier when engine has one
+    include_related: bool = True  # attach related train-row ids
+    metrics_path: str | None = None  # JSONL events (None = in-memory)
+
+
+class InfluenceService:
+    """Serve a stream of (user, item) influence queries over one engine.
+
+    Args:
+      engine: an :class:`~fia_tpu.influence.engine.InfluenceEngine`
+        (fixed-engine mode), or
+      engine_provider: a zero-arg callable returning the current engine
+        — the :meth:`from_model` path, so a retrained
+        :class:`~fia_tpu.api.FIAModel` transparently swaps a fresh
+        engine in and the fingerprinted cache keys retire stale entries.
+      config: a :class:`ServeConfig`.
+      clock: monotonic-seconds callable (injectable for deterministic
+        tests and simulated open-loop load).
+    """
+
+    def __init__(self, engine=None, engine_provider=None,
+                 config: ServeConfig | None = None,
+                 clock=time.monotonic):
+        if (engine is None) == (engine_provider is None):
+            raise ValueError("pass exactly one of engine/engine_provider")
+        self._engine_static = engine
+        self._engine_provider = engine_provider
+        self.config = config or ServeConfig()
+        self.clock = clock
+        self.cache = HotBlockCache(self.config.cache_entries,
+                                   self.config.cache_bytes)
+        self.metrics = ServeMetrics(self.config.metrics_path)
+        self.batcher = MicroBatcher(
+            self.config.max_batch, self.config.coalesce,
+            pad_bucket=int(getattr(self._peek_engine(), "pad_bucket", 128)),
+        )
+        eng = self._peek_engine()
+        self.admission = AdmissionController(
+            max_queue=self.config.max_queue,
+            default_deadline_s=self.config.default_deadline_s,
+            num_users=eng.model.num_users,
+            num_items=eng.model.num_items,
+        )
+        self._queue: list[Ticket] = []
+        self._next_id = 0
+        self._batch_id = 0
+        self._fp_cache: tuple | None = None  # (engine identity, digest)
+        # dispatch log: (batch_id, (T, 2) points) per device dispatch —
+        # the byte-identity tests and capacity post-mortems read this
+        self.dispatch_log: list[tuple[int, np.ndarray]] = []
+
+    # -- wiring ------------------------------------------------------------
+    @classmethod
+    def from_model(cls, model, config: ServeConfig | None = None,
+                   solver: str | None = None, clock=time.monotonic,
+                   **engine_extra) -> "InfluenceService":
+        """A service over an :class:`~fia_tpu.api.FIAModel`.
+
+        The engine is resolved lazily through ``model.engine()`` (the
+        one solver-resolution path), so ``model.retrain`` /
+        ``update_train_x_y`` — which clear the model's engines and
+        notify derived services — leave the service answering from
+        fresh state, never a stale hot block.
+        """
+        svc = cls(
+            engine_provider=lambda: model.engine(solver, **engine_extra),
+            config=config, clock=clock,
+        )
+        model._register_serving(svc)
+        return svc
+
+    def _peek_engine(self):
+        return (self._engine_static if self._engine_static is not None
+                else self._engine_provider())
+
+    def _engine_and_fp(self):
+        eng = self._peek_engine()
+        if self._fp_cache is not None and self._fp_cache[0] is eng:
+            return eng, self._fp_cache[1]
+        fp = hashlib.sha1(
+            np.ascontiguousarray(eng._params_fingerprint()).tobytes()
+        ).hexdigest()
+        self._fp_cache = (eng, fp)
+        return eng, fp
+
+    def invalidate(self) -> None:
+        """Drop every serving-layer cache derived from model state.
+
+        Called by ``FIAModel._invalidate()`` (retrain, checkpoint load,
+        train-set mutation). The fingerprinted keys already make stale
+        hits impossible; this additionally frees the dead entries and
+        forgets the memoized engine fingerprint.
+        """
+        self.cache.invalidate()
+        self._fp_cache = None
+
+    # -- request intake ----------------------------------------------------
+    def submit(self, req: Request) -> Response | None:
+        """Admit ``req`` into the queue, or reject it immediately.
+
+        Returns None when admitted (the answer arrives from a later
+        :meth:`drain`), or a rejected :class:`Response`.
+        """
+        if req.id is None:
+            req.id = f"r{self._next_id}"
+        self._next_id += 1
+        reason = self.admission.reject_reason(req, len(self._queue))
+        if reason is not None:
+            resp = Response(
+                id=req.id, user=req.user, item=req.item,
+                status=STATUS_REJECTED, reason=reason,
+            )
+            self.metrics.record_request(resp)
+            return resp
+        self._queue.append(self.admission.ticket(req, self.clock()))
+        return None
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    # -- the drain loop ----------------------------------------------------
+    def drain(self) -> list[Response]:
+        """Resolve every queued ticket (see module docstring)."""
+        if not self._queue:
+            return []
+        work, self._queue = self._queue, []
+        eng, fp = self._engine_and_fp()
+        now = self.clock()
+
+        responses: dict[int, Response] = {}  # queue position -> response
+        live: list[tuple[int, Ticket]] = []
+        for pos, t in enumerate(work):
+            if t.expired(now):
+                responses[pos] = self._reject(t, REASON_DEADLINE, now)
+            else:
+                live.append((pos, t))
+
+        # cache tiers first; misses keep first-arrival order per key
+        misses: dict[tuple, list[tuple[int, Ticket]]] = {}
+        for pos, t in live:
+            key = (fp, eng.solver) + t.req.key()
+            entry = self.cache.get(key)
+            if entry is not None:
+                responses[pos] = self._respond(t, entry, TIER_HOT, now, eng)
+                continue
+            entry = self._disk_get(eng, fp, t.req)
+            if entry is not None:
+                self.cache.put(key, entry)
+                responses[pos] = self._respond(t, entry, TIER_DISK, now, eng)
+                continue
+            misses.setdefault(key, []).append((pos, t))
+
+        if misses:
+            self._dispatch_misses(eng, fp, misses, responses)
+
+        out = [responses[pos] for pos in sorted(responses)]
+        for r in out:
+            self.metrics.record_request(r)
+        return out
+
+    def _dispatch_misses(self, eng, fp, misses, responses) -> None:
+        keys = list(misses.keys())  # first-arrival order (dict insertion)
+        points = np.asarray([[k[2], k[3]] for k in keys], np.int64)
+        counts = eng.index.counts_batch(points)
+        for batch in self.batcher.plan(counts):
+            bid = self._batch_id
+            self._batch_id += 1
+            bpts = points[batch]
+            self.dispatch_log.append((bid, np.array(bpts)))
+            t0 = self.clock()
+            try:
+                inject.fire("serve.dispatch")
+                res = eng.query_batch(bpts)
+            except Exception as e:
+                kind = taxonomy.classify(e)
+                if kind is None:
+                    raise
+                dt = self.clock() - t0
+                self.metrics.record_batch(
+                    bid, len(batch), int(counts[batch].sum()), dt,
+                    status=kind,
+                )
+                for j in batch:
+                    for pos, t in misses[keys[int(j)]]:
+                        responses[pos] = self._reject(
+                            t, kind, self.clock(), batch_id=bid,
+                            batch_size=len(batch),
+                        )
+                continue
+            dt = self.clock() - t0
+            self.metrics.record_batch(
+                bid, len(batch), int(counts[batch].sum()), dt
+            )
+            now = self.clock()
+            for row, j in enumerate(batch):
+                key = keys[int(j)]
+                entry = BlockEntry(
+                    scores=np.array(res.scores_of(row)),
+                    ihvp=np.array(res.ihvp[row]),
+                    test_grad=np.array(res.test_grad[row]),
+                    count=int(res.counts[row]),
+                )
+                self.cache.put(key, entry)
+                self._disk_put(eng, fp, key, entry)
+                waiting = misses[key]
+                for rank, (pos, t) in enumerate(waiting):
+                    # first waiter per key pays the compute; duplicates
+                    # coalesced into the same drain are hot-tier hits
+                    tier = TIER_COMPUTE if rank == 0 else TIER_HOT
+                    if rank > 0:
+                        self.cache.stats.hits_hot += 1
+                    responses[pos] = self._respond(
+                        t, entry, tier, now, eng, solve_s=dt,
+                        batch_id=bid, batch_size=len(batch),
+                    )
+
+    # -- response/tier helpers --------------------------------------------
+    def _respond(self, t: Ticket, entry: BlockEntry, tier: str, now: float,
+                 eng, solve_s: float = 0.0, batch_id=None,
+                 batch_size=None) -> Response:
+        related = None
+        if self.config.include_related:
+            related = eng.index.related(int(t.req.user), int(t.req.item))
+        return Response(
+            id=t.req.id, user=t.req.user, item=t.req.item,
+            scores=entry.scores, related=related, ihvp=entry.ihvp,
+            test_grad=entry.test_grad, cache_tier=tier,
+            queue_wait_s=max(now - t.t_arrival, 0.0), solve_s=solve_s,
+            batch_id=batch_id, batch_size=batch_size,
+        )
+
+    def _reject(self, t: Ticket, reason: str, now: float, batch_id=None,
+                batch_size=None) -> Response:
+        return Response(
+            id=t.req.id, user=t.req.user, item=t.req.item,
+            status=STATUS_REJECTED, reason=reason,
+            queue_wait_s=max(now - t.t_arrival, 0.0),
+            batch_id=batch_id, batch_size=batch_size,
+        )
+
+    def _disk_dir(self, eng) -> str | None:
+        if not self.config.disk_cache or not eng.cache_dir:
+            return None
+        return eng.cache_dir
+
+    def _disk_get(self, eng, fp: str, req: Request) -> BlockEntry | None:
+        d = self._disk_dir(eng)
+        if d is None:
+            return None
+        path = scache.disk_entry_path(
+            d, eng.model_name, eng.solver, req.user, req.item
+        )
+        e = scache.disk_get(
+            path, scache.disk_fingerprint(eng.model_name, eng.solver, fp),
+            stats=self.cache.stats,
+        )
+        if e is not None:
+            self.cache.stats.hits_disk += 1
+        return e
+
+    def _disk_put(self, eng, fp: str, key: tuple, entry: BlockEntry) -> None:
+        d = self._disk_dir(eng)
+        if d is None:
+            return
+        scache.disk_put(
+            scache.disk_entry_path(d, eng.model_name, eng.solver,
+                                   key[2], key[3]),
+            entry,
+            scache.disk_fingerprint(eng.model_name, eng.solver, fp),
+        )
+
+    # -- convenience -------------------------------------------------------
+    def run(self, requests, drain_every: int | None = None
+            ) -> list[Response]:
+        """Submit a request iterable and drain to completion.
+
+        ``drain_every``: drain after every N submits (None = one drain
+        at the end — maximal coalescing). Responses return in
+        submission order.
+        """
+        by_id: dict[str, Response] = {}
+        order: list[str] = []
+        n = 0
+        for req in requests:
+            if not isinstance(req, Request):
+                req = Request(*req)
+            r = self.submit(req)
+            order.append(req.id)
+            if r is not None:
+                by_id[req.id] = r
+            n += 1
+            if drain_every and n % drain_every == 0:
+                for resp in self.drain():
+                    by_id[resp.id] = resp
+        for resp in self.drain():
+            by_id[resp.id] = resp
+        return [by_id[i] for i in order]
+
+    def rollup(self) -> dict:
+        return self.metrics.rollup(self.cache.stats.json())
+
+    def close(self) -> dict:
+        """Final rollup (logged to the metrics JSONL) + release files."""
+        r = self.metrics.log_rollup(self.cache.stats.json())
+        self.metrics.close()
+        return r
+
+    # -- warmup ------------------------------------------------------------
+    def warmup(self, points: np.ndarray, fill_cache: bool = False) -> dict:
+        """Precompile the bucket ladder by dispatching the batches the
+        scheduler would plan for ``points``.
+
+        Dispatching real batches (rather than AOT-lowering shapes) is
+        deliberate: it exercises the exact jit caches serving hits —
+        per (T, pad-bucket) program shape — and warms the backend's
+        autotuning state. ``fill_cache=True`` additionally banks the
+        warmup results in the hot/disk tiers (useful when ``points``
+        are the expected hot set, not synthetic).
+
+        Returns {"batches", "compiled_keys", "seconds"}.
+        """
+        eng, fp = self._engine_and_fp()
+        points = np.asarray(points)
+        if points.ndim == 1:
+            points = points[None, :]
+        before = set(eng._jitted)
+        t0 = time.perf_counter()
+        counts = eng.index.counts_batch(points)
+        nb = 0
+        for batch in self.batcher.plan(counts):
+            bpts = points[batch]
+            res = eng.query_batch(bpts)
+            nb += 1
+            if fill_cache:
+                for row, j in enumerate(batch):
+                    key = (fp, eng.solver, int(bpts[row, 0]),
+                           int(bpts[row, 1]))
+                    entry = BlockEntry(
+                        scores=np.array(res.scores_of(row)),
+                        ihvp=np.array(res.ihvp[row]),
+                        test_grad=np.array(res.test_grad[row]),
+                        count=int(res.counts[row]),
+                    )
+                    self.cache.put(key, entry)
+                    self._disk_put(eng, fp, key, entry)
+        return {
+            "batches": nb,
+            "compiled_keys": sorted(
+                str(k) for k in set(eng._jitted) - before
+            ),
+            "seconds": round(time.perf_counter() - t0, 3),
+        }

@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""Human latency/cache report over a serving metrics JSONL.
+
+Reads the ``serve.request`` / ``serve.batch`` / ``serve.rollup`` lines
+(schema: fia_tpu/serve/metrics.py) emitted by the service and prints
+queue-wait and solve percentiles, cache-tier hit rates, batch shape
+stats and rejection reasons.
+
+  python scripts/latency_report.py output/serve-MF-synthetic.jsonl
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+import numpy as np
+
+
+def pcts(vals):
+    if not vals:
+        return "n=0"
+    a = np.asarray(vals, np.float64)
+    return (f"n={len(a)}  p50={np.percentile(a, 50):.2f}ms  "
+            f"p95={np.percentile(a, 95):.2f}ms  max={a.max():.2f}ms")
+
+
+def load(path: str):
+    reqs, batches, rollups = [], [], []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                d = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn tail line from a killed process
+            ev = d.get("event")
+            if ev == "serve.request":
+                reqs.append(d)
+            elif ev == "serve.batch":
+                batches.append(d)
+            elif ev == "serve.rollup":
+                rollups.append(d)
+    return reqs, batches, rollups
+
+
+def main(argv) -> int:
+    if len(argv) != 2:
+        print(__doc__.strip(), file=sys.stderr)
+        return 2
+    reqs, batches, rollups = load(argv[1])
+    if not reqs and not rollups:
+        print(f"no serving events in {argv[1]}", file=sys.stderr)
+        return 1
+
+    ok = [r for r in reqs if r["status"] == "ok"]
+    rejected = [r for r in reqs if r["status"] != "ok"]
+    print(f"requests: {len(reqs)}  ok: {len(ok)}  "
+          f"rejected: {len(rejected)}")
+
+    by_reason: dict[str, int] = {}
+    for r in rejected:
+        k = r.get("reason") or "<unreasoned!>"
+        by_reason[k] = by_reason.get(k, 0) + 1
+    for k in sorted(by_reason):
+        print(f"  rejected[{k}]: {by_reason[k]}")
+
+    by_tier: dict[str, int] = {}
+    for r in ok:
+        t = r.get("tier") or "?"
+        by_tier[t] = by_tier.get(t, 0) + 1
+    served = sum(by_tier.values())
+    for t in ("hot", "disk", "compute"):
+        if t in by_tier:
+            print(f"  tier[{t}]: {by_tier[t]} "
+                  f"({100.0 * by_tier[t] / served:.1f}%)")
+
+    print(f"queue wait: {pcts([r['queue_wait_ms'] for r in ok])}")
+    print(f"solve:      {pcts([r['solve_ms'] for r in ok])}")
+
+    if batches:
+        sizes = [b["size"] for b in batches]
+        print(f"batches: {len(batches)}  "
+              f"mean size {np.mean(sizes):.1f}  max {max(sizes)}  "
+              f"dispatch {pcts([b['solve_ms'] for b in batches])}")
+    if rollups:
+        last = rollups[-1]
+        cache = last.get("cache", {})
+        if cache:
+            print("cache: " + "  ".join(
+                f"{k}={cache[k]}" for k in sorted(cache)))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv))

@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# Serving smoke: a 200-query synthetic open-loop stream through
+# fia_tpu.cli.serve on CPU, asserting (in-process, see run_smoke):
+#   - every request either succeeded or was rejected WITH a reason
+#   - the hot-block cache absorbed repeats (hits > 0)
+# then a human latency report over the metrics JSONL.
+#
+#   bash scripts/serve_smoke.sh        (or: make serve-smoke)
+#
+# Budget: <60s on CPU — tiny synthetic splits, 300 training steps,
+# embed 4. The checkpoint/caches land in a throwaway tmpdir so repeated
+# runs stay hermetic.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+DIR=$(mktemp -d /tmp/fia_serve_smoke.XXXXXX)
+trap 'rm -rf "$DIR"' EXIT
+
+JAX_PLATFORMS=cpu timeout -k 10 300 python -m fia_tpu.cli.serve \
+  --dataset synthetic --synth_users 60 --synth_items 40 \
+  --synth_train 2000 --synth_test 100 \
+  --model MF --embed_size 4 --num_steps_train 300 \
+  --train_dir "$DIR" --metrics "$DIR/serve.jsonl" \
+  --max_batch 16 --smoke_requests 200
+
+python scripts/latency_report.py "$DIR/serve.jsonl"
+echo "serve-smoke PASS"

@@ -1,0 +1,436 @@
+"""The online serving layer (fia_tpu/serve): micro-batching, hot/disk
+caching, admission control — and its contracts with the engine:
+
+- byte identity: serving must not change answers. The admitted stream's
+  results are bit-identical to ``engine.query_many`` over the same
+  dispatch order (the scheduler's chunking contract).
+- deterministic shed: overload and injected faults reject requests with
+  classified reasons; a replayed stream sheds the same set.
+- cache correctness: hot hits are bit-identical to the compute that
+  filled them; disk entries verify-on-read (a torn publish is a clean
+  recompute, never poison); retraining invalidates everything.
+"""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from fia_tpu.data.dataset import RatingDataset
+from fia_tpu.data.index import InteractionIndex
+from fia_tpu.influence.engine import InfluenceEngine
+from fia_tpu.models import MF
+from fia_tpu.reliability import inject, taxonomy
+from fia_tpu.reliability import policy as rpolicy
+from fia_tpu.reliability.journal import Journal, JournalMismatch
+from fia_tpu.serve import (
+    InfluenceService,
+    MicroBatcher,
+    Request,
+    ServeConfig,
+)
+
+U, I, K = 30, 20, 4
+WD = 1e-2
+DAMP = 1e-3
+
+
+def _setup(seed=0, n=400):
+    rng = np.random.default_rng(seed)
+    x = np.stack(
+        [rng.integers(0, U, n), rng.integers(0, I, n)], axis=1
+    ).astype(np.int32)
+    y = rng.integers(1, 6, n).astype(np.float32)
+    train = RatingDataset(x, y)
+    model = MF(U, I, K, WD)
+    params = model.init_params(jax.random.PRNGKey(seed))
+    return model, params, train
+
+
+def _engine(model, params, train, **kw):
+    kw.setdefault("damping", DAMP)
+    kw.setdefault("solver", "direct")
+    return InfluenceEngine(model, params, train, **kw)
+
+
+def _unique_points(train, n):
+    """n distinct (u, i) pairs drawn from the train stream."""
+    uniq = np.unique(train.x, axis=0)
+    assert len(uniq) >= n
+    return uniq[:n].astype(np.int64)
+
+
+def _service(engine, **cfg):
+    cfg.setdefault("disk_cache", False)
+    return InfluenceService(engine=engine, config=ServeConfig(**cfg))
+
+
+class TestByteIdentity:
+    def test_admitted_results_match_query_many(self):
+        """The tentpole contract: the coalesced dispatch stream is
+        reproducible by query_many over the scheduler's order, and the
+        per-request payloads are bit-identical to it."""
+        model, params, train = _setup()
+        pts = _unique_points(train, 11)
+        mb = 4
+
+        eng = _engine(model, params, train)
+        svc = _service(eng, max_batch=mb)
+        responses = svc.run([Request(int(u), int(i)) for u, i in pts])
+        assert all(r.ok for r in responses)
+
+        eng2 = _engine(model, params, train)
+        order = MicroBatcher(mb, "bucket",
+                             pad_bucket=eng2.pad_bucket).order(
+            eng2.index.counts_batch(pts)
+        )
+        many = eng2.query_many(pts[order], batch_queries=mb)
+
+        # dispatch stream == query_many's batch split, batch for batch
+        chunks = [pts[order][s: s + mb] for s in range(0, len(pts), mb)]
+        assert len(svc.dispatch_log) == len(chunks)
+        for (_, got), want in zip(svc.dispatch_log, chunks):
+            assert np.array_equal(got, want)
+
+        # per-request payloads: bit-identical, not just close
+        flat = [(res, t) for res in many for t in range(len(res.counts))]
+        for rank, pos in enumerate(order):
+            res, t = flat[rank]
+            r = responses[pos]
+            assert np.array_equal(r.scores, res.scores_of(t))
+            assert np.array_equal(r.ihvp, res.ihvp[t])
+            assert np.array_equal(r.test_grad, res.test_grad[t])
+
+    def test_duplicates_compute_once_and_hit_bit_identical(self):
+        model, params, train = _setup()
+        u, i = (int(v) for v in _unique_points(train, 1)[0])
+        eng = _engine(model, params, train)
+        svc = _service(eng)
+        first, dup = svc.run([Request(u, i), Request(u, i)])
+        assert first.cache_tier == "compute"
+        assert dup.cache_tier == "hot"
+        assert np.array_equal(first.scores, dup.scores)
+        assert len(svc.dispatch_log) == 1  # one device dispatch total
+
+        # a later drain hits the hot tier without touching the device
+        again = svc.run([Request(u, i)])[0]
+        assert again.cache_tier == "hot"
+        assert np.array_equal(again.scores, first.scores)
+        assert len(svc.dispatch_log) == 1
+
+
+class TestAdmissionAndDeadlines:
+    def test_overload_sheds_newest_deterministically(self):
+        model, params, train = _setup()
+        pts = _unique_points(train, 8)
+        eng = _engine(model, params, train)
+
+        def run_stream():
+            svc = _service(eng, max_queue=5)
+            return svc.run(
+                [Request(int(u), int(i), id=f"q{k}")
+                 for k, (u, i) in enumerate(pts)],
+                # no intermediate drain: all 8 submits race the bound
+            )
+
+        out = run_stream()
+        shed = [r.id for r in out if not r.ok]
+        assert shed == ["q5", "q6", "q7"]  # newest-sheds, queue bound 5
+        assert all(r.reason == "overload" for r in out if not r.ok)
+        assert run_stream() is not None
+        assert [r.id for r in run_stream() if not r.ok] == shed
+
+    def test_invalid_ids_rejected_at_the_door(self):
+        model, params, train = _setup()
+        svc = _service(_engine(model, params, train))
+        out = svc.run([Request(U + 5, 0), Request(0, -1), Request(0, 0)])
+        assert [r.status for r in out] == ["rejected", "rejected", "ok"]
+        assert out[0].reason == "invalid"
+        assert out[1].reason == "invalid"
+
+    def test_queued_past_deadline_rejected_with_taxonomy_kind(self):
+        model, params, train = _setup()
+        eng = _engine(model, params, train)
+        t = [0.0]
+        svc = InfluenceService(
+            engine=eng,
+            config=ServeConfig(disk_cache=False, default_deadline_s=1.0),
+            clock=lambda: t[0],
+        )
+        u, i = (int(v) for v in train.x[0])
+        assert svc.submit(Request(u, i)) is None
+        t[0] = 5.0  # budget long gone before the drain runs
+        out = svc.drain()
+        assert out[0].status == "rejected"
+        assert out[0].reason == taxonomy.DEADLINE
+
+    def test_injected_deadline_fault_sheds_batch_stream_completes(self):
+        """The ISSUE acceptance scenario: a deadline fault at
+        ``serve.dispatch`` rejects exactly that batch with the taxonomy
+        kind; the rest of the stream completes, and the surviving
+        results are byte-identical to the engine's own answers."""
+        model, params, train = _setup()
+        pts = _unique_points(train, 6)
+        mb = 3
+        eng = _engine(model, params, train)
+        svc = _service(eng, max_batch=mb)
+        reqs = [Request(int(u), int(i), id=f"q{k}")
+                for k, (u, i) in enumerate(pts)]
+        with inject.active(inject.Fault("serve.dispatch", at=0,
+                                        kind="deadline")) as plan:
+            out = svc.run(reqs)
+        assert plan.unfired() == []
+
+        rejected = [r for r in out if not r.ok]
+        ok = [r for r in out if r.ok]
+        assert len(rejected) == mb and len(ok) == mb
+        assert all(r.reason == taxonomy.DEADLINE for r in rejected)
+
+        # survivors: byte-identical to querying the engine directly
+        # with the surviving dispatch batch (dispatch_log holds it)
+        survivors = [b for b in svc.dispatch_log]
+        direct = _engine(model, params, train).query_batch(survivors[1][1])
+        by_key = {(int(p[0]), int(p[1])): t
+                  for t, p in enumerate(survivors[1][1])}
+        for r in ok:
+            t = by_key[(r.user, r.item)]
+            assert np.array_equal(r.scores, direct.scores_of(t))
+
+
+class TestDiskTier:
+    def test_disk_hit_after_process_restart(self, tmp_path):
+        model, params, train = _setup()
+        u, i = (int(v) for v in train.x[0])
+        eng1 = _engine(model, params, train, cache_dir=str(tmp_path))
+        svc1 = InfluenceService(engine=eng1, config=ServeConfig())
+        first = svc1.run([Request(u, i)])[0]
+        assert first.cache_tier == "compute"
+
+        # a fresh service over a fresh engine (same params): the hot
+        # tier is empty, the verified disk entry answers
+        eng2 = _engine(model, params, train, cache_dir=str(tmp_path))
+        svc2 = InfluenceService(engine=eng2, config=ServeConfig())
+        hit = svc2.run([Request(u, i)])[0]
+        assert hit.cache_tier == "disk"
+        assert np.array_equal(hit.scores, first.scores)
+        assert len(svc2.dispatch_log) == 0
+
+    def test_torn_disk_entry_is_a_clean_recompute(self, tmp_path):
+        model, params, train = _setup()
+        u, i = (int(v) for v in train.x[0])
+        eng1 = _engine(model, params, train, cache_dir=str(tmp_path))
+        svc1 = InfluenceService(engine=eng1, config=ServeConfig())
+        with inject.active(inject.Fault("serve.cache_publish", at=0,
+                                        kind="torn")) as plan:
+            first = svc1.run([Request(u, i)])[0]
+        assert plan.unfired() == []
+        assert first.ok  # the damage is on disk, not in the answer
+
+        eng2 = _engine(model, params, train, cache_dir=str(tmp_path))
+        svc2 = InfluenceService(engine=eng2, config=ServeConfig())
+        got = svc2.run([Request(u, i)])[0]
+        assert got.ok and got.cache_tier == "compute"  # verified miss
+        assert svc2.cache.stats.disk_rejects == 1
+        assert np.array_equal(got.scores, first.scores)
+        # the corrupt generation was quarantined, then overwritten clean
+        quarantined = [p for p in os.listdir(tmp_path / "serve")
+                       if p.endswith(".corrupt")]
+        assert quarantined
+        eng3 = _engine(model, params, train, cache_dir=str(tmp_path))
+        svc3 = InfluenceService(engine=eng3, config=ServeConfig())
+        assert svc3.run([Request(u, i)])[0].cache_tier == "disk"
+
+    def test_shared_cache_dir_interleaved_services_stay_keyed(
+        self, tmp_path
+    ):
+        """Two services with different solve configs interleave drains
+        over ONE cache_dir: neither may serve the other's blocks (the
+        solver is in the key), and their query_many journals refuse
+        each other's fingerprints."""
+        model, params, train = _setup()
+        pts = _unique_points(train, 4)
+        eng_a = _engine(model, params, train, cache_dir=str(tmp_path))
+        eng_b = _engine(model, params, train, cache_dir=str(tmp_path),
+                        solver="cg", cg_maxiter=50)
+        svc_a = InfluenceService(engine=eng_a, config=ServeConfig())
+        svc_b = InfluenceService(engine=eng_b, config=ServeConfig())
+
+        # interleave: a, b, a, b over the same points
+        for u, i in pts:
+            ra = svc_a.run([Request(int(u), int(i))])[0]
+            rb = svc_b.run([Request(int(u), int(i))])[0]
+            assert ra.ok and rb.ok
+        # every b-answer was computed, never read from a's entries
+        assert all(r[1].shape[0] for r in svc_b.dispatch_log)
+        assert svc_b.cache.stats.hits_disk == 0
+
+        # restart-shaped check: a's disk entries answer a's config...
+        svc_a2 = InfluenceService(
+            engine=_engine(model, params, train, cache_dir=str(tmp_path)),
+            config=ServeConfig(),
+        )
+        u, i = (int(v) for v in pts[0])
+        assert svc_a2.run([Request(u, i)])[0].cache_tier == "disk"
+
+        # ...and the journal layer enforces the same separation for
+        # resumable query_many workloads sharing the directory
+        jpath = str(tmp_path / "stream.journal")
+        with Journal.open(jpath, eng_a.journal_fingerprint(pts, 2)) as j:
+            eng_a.query_many(pts, batch_queries=2, journal=j)
+        with pytest.raises(JournalMismatch):
+            Journal.open(jpath, eng_b.journal_fingerprint(pts, 2),
+                         resume=True)
+
+
+class TestInvalidation:
+    def test_retrain_invalidates_serving_caches(self):
+        """Satellite 1: FIAModel._invalidate reaches the serving layer —
+        a post-retrain query recomputes instead of hot-hitting."""
+        from fia_tpu.api import FIAModel
+
+        model, params, train = _setup()
+        ds = {"train": train, "validation": train, "test": train}
+        m = FIAModel("MF", U, I, K, weight_decay=WD, batch_size=64,
+                     data_sets=ds, damping=DAMP, solver="direct",
+                     train_dir="")
+        svc = m.serve(config=ServeConfig(disk_cache=False))
+        u, i = (int(v) for v in train.x[0])
+        before = svc.run([Request(u, i)])[0]
+        assert svc.run([Request(u, i)])[0].cache_tier == "hot"
+
+        m.retrain(num_steps=5)
+        assert svc.cache.stats.invalidations == 1
+        after = svc.run([Request(u, i)])[0]
+        assert after.cache_tier == "compute"  # stale hot entry retired
+        assert not np.array_equal(after.scores, before.scores)
+
+    def test_fingerprint_key_guards_even_without_invalidate(self):
+        """Belt and braces: even a service nobody told about a params
+        change cannot serve stale blocks — the fingerprint in the key
+        misses."""
+        model, params, train = _setup()
+        eng1 = _engine(model, params, train)
+        engines = [eng1]
+        svc = InfluenceService(engine_provider=lambda: engines[-1],
+                               config=ServeConfig(disk_cache=False))
+        u, i = (int(v) for v in train.x[0])
+        svc.run([Request(u, i)])
+
+        p2 = model.init_params(jax.random.PRNGKey(99))
+        engines.append(_engine(model, p2, train))  # swapped, no invalidate
+        r = svc.run([Request(u, i)])[0]
+        assert r.cache_tier == "compute"
+
+
+class TestIndexMemoAndCompileCache:
+    def test_related_memo_hits_and_is_write_protected(self):
+        model, params, train = _setup()
+        idx = InteractionIndex(train.x, U, I)
+        u, i = (int(v) for v in train.x[0])
+        a = idx.related(u, i)
+        b = idx.related(u, i)
+        assert a is b and idx.memo_hits == 1
+        with pytest.raises(ValueError):
+            a[0] = 7
+        assert np.array_equal(
+            a, np.concatenate([idx.rows_of_user(u), idx.rows_of_item(i)])
+        )
+
+    def test_single_query_padded_memo(self):
+        model, params, train = _setup()
+        idx = InteractionIndex(train.x, U, I)
+        pt = train.x[:1]
+        r1 = idx.related_padded(pt, bucket=16)
+        r2 = idx.related_padded(pt, bucket=16)
+        assert r1[0] is r2[0] and r1[1] is r2[1]
+
+    def test_same_bucket_queries_share_compiled_program(self):
+        """Satellite 2: two different queries landing in the same pad
+        bucket must not recompile (padded path, where pad shape keys
+        the jit cache)."""
+        model, params, train = _setup()
+        eng = _engine(model, params, train, impl="padded")
+        svc = _service(eng, coalesce="fifo", max_batch=1)
+        counts = eng.index.counts_batch(train.x)
+        # two distinct points, same bucketed pad
+        from fia_tpu.data.index import bucketed_pad
+
+        by_pad = {}
+        for (u, i), c in zip(np.unique(train.x, axis=0),
+                             eng.index.counts_batch(
+                                 np.unique(train.x, axis=0))):
+            by_pad.setdefault(
+                bucketed_pad(int(c), eng.pad_bucket), []
+            ).append((int(u), int(i)))
+        pair = next(v for v in by_pad.values() if len(v) >= 2)[:2]
+
+        svc.run([Request(*pair[0])])
+        compiled = len(eng._jitted)
+        svc.run([Request(*pair[1])])
+        assert len(eng._jitted) == compiled  # same bucket, no recompile
+
+    def test_warmup_precompiles_the_serving_buckets(self):
+        model, params, train = _setup()
+        pts = _unique_points(train, 8)
+        eng = _engine(model, params, train)
+        svc = _service(eng, max_batch=4)
+        info = svc.warmup(pts)
+        assert info["batches"] == 2
+        compiled = len(eng._jitted)
+        out = svc.run([Request(int(u), int(i)) for u, i in pts])
+        assert all(r.ok for r in out)
+        assert len(eng._jitted) == compiled  # serving hit warm programs
+
+
+class TestSolverResolution:
+    def test_resolve_solver_walks_the_ladder(self):
+        assert rpolicy.resolve_solver(None, default="direct") == "direct"
+        assert rpolicy.resolve_solver("lissa") == "lissa"
+        # full engine: no direct rung — ladder lands on cg
+        assert rpolicy.resolve_solver(
+            "direct", supported=rpolicy.FULL_SOLVERS) == "cg"
+        assert rpolicy.resolve_solver(
+            "schulz", supported=rpolicy.FULL_SOLVERS) == "cg"
+        assert rpolicy.resolve_solver(
+            None, default="lissa", supported=rpolicy.FULL_SOLVERS
+        ) == "lissa"
+
+    def test_get_inverse_hvp_honours_model_solver(self):
+        """Satellite 6: the api no longer hardcodes approx_type='cg' —
+        a direct-solver model resolves through the one path (direct has
+        no full-Hessian rung, so it maps to cg) instead of crashing or
+        silently diverging from the configured solver."""
+        from fia_tpu.api import FIAModel
+
+        model, params, train = _setup(n=120)
+        ds = {"train": train, "validation": train, "test": train}
+        m = FIAModel("MF", U, I, K, weight_decay=WD, batch_size=64,
+                     data_sets=ds, damping=1e-2, solver="direct",
+                     train_dir="")
+        d = sum(int(np.asarray(l).size)
+                for l in jax.tree_util.tree_leaves(m.params))
+        v = np.ones(d, np.float32)
+        x = np.asarray(m.get_inverse_hvp(v))  # would ValueError before
+        assert x.shape == (d,) and np.isfinite(x).all()
+
+
+class TestSmoke:
+    def test_inprocess_smoke_stream(self):
+        """The CI gate's in-process form: a 200-query repeat-heavy
+        stream — nothing dropped without a reason, the hot tier absorbs
+        repeats, accounting adds up."""
+        from fia_tpu.cli.serve import smoke_stream
+
+        model, params, train = _setup()
+        eng = _engine(model, params, train)
+        svc = _service(eng, max_batch=16)
+        reqs = smoke_stream(train.x, 200, hot_frac=0.5, seed=3)
+        out = svc.run(reqs, drain_every=16)
+        assert len(out) == 200
+        assert not [r for r in out if not r.ok and not r.reason]
+        assert svc.cache.stats.hits_hot > 0
+        roll = svc.rollup()
+        assert roll["ok"] + sum(roll["rejected"].values()) == 200
+        assert roll["ok"] == 200  # no deadline/queue pressure here
+        assert roll["solve_ms"]["p95"] >= roll["solve_ms"]["p50"] >= 0
