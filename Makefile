@@ -1,23 +1,28 @@
 # Convenience targets. The commands themselves are pinned in
 # ROADMAP.md (tier-1) and scripts/ — these targets just name them.
 
-.PHONY: tier1 test lint-io serve-smoke
+.PHONY: tier1 test lint lint-io serve-smoke
 
 # The ROADMAP.md tier-1 verify: fast CPU suite, slow tests excluded.
-# The raw-writes lint runs first as a non-fatal report (the `-` prefix);
-# `make lint-io` is the enforcing form.
+# Lint is fatal — a finding fails the build before pytest runs.
 tier1:
-	-bash scripts/check_raw_writes.sh
+	python -m fia_tpu.analysis.lint fia_tpu scripts bench.py
 	bash scripts/tier1.sh
 
 # Full suite (includes slow-marked tests; needs more wall clock).
 test:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -p no:cacheprovider
 
-# Enforced: artifact writes outside utils/io.py + reliability/artifacts.py
-# fail the build.
+# The AST lint engine: raw-write discipline, jit trace hygiene,
+# fault-site integrity, metrics schema drift. docs/lint.md has the
+# rule catalog; `# fialint: disable=RULE -- why` suppresses a line.
+lint:
+	python -m fia_tpu.analysis.lint fia_tpu scripts bench.py
+
+# Back-compat alias for the retired scripts/check_raw_writes.sh:
+# just the raw-write rule (FIA101) of the engine above.
 lint-io:
-	bash scripts/check_raw_writes.sh
+	python -m fia_tpu.analysis.lint --select FIA101 fia_tpu scripts bench.py
 
 # Serving smoke: 200-query synthetic stream through fia_tpu.cli.serve
 # on CPU (<60s) — zero unreasoned drops, hot-cache hits, latency report.

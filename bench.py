@@ -497,8 +497,9 @@ def main():
             print("WARNING: --json_out missing path operand; "
                   "stdout-only", file=sys.stderr)
         else:
-            with open(sys.argv[idx], "w") as fh:
-                fh.write(json.dumps(out) + "\n")
+            from fia_tpu.utils.io import save_json_atomic
+
+            save_json_atomic(sys.argv[idx], out)
 
 
 def serve_main():
@@ -607,7 +608,35 @@ def serve_main():
     print(json.dumps(out))
 
 
+def _lint_preflight() -> None:
+    """``--lint``: fail fast on lint findings before burning device time.
+
+    Runs the AST lint engine (fia_tpu/analysis) over the package,
+    scripts/ and this file — the same scope as ``make lint`` — and
+    exits 2 on findings so an orchestration sweep aborts before the
+    first compile rather than after the last measurement.
+    """
+    import contextlib
+
+    from fia_tpu.analysis import lint as fialint
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    # report on stderr: stdout stays the one-JSON-line contract
+    with contextlib.redirect_stdout(sys.stderr):
+        rc = fialint.main([
+            os.path.join(here, "fia_tpu"),
+            os.path.join(here, "scripts"),
+            os.path.abspath(__file__),
+        ])
+    if rc != 0:
+        print("bench: lint preflight failed (fix findings or justify "
+              "suppressions; see docs/lint.md)", file=sys.stderr)
+        raise SystemExit(2)
+
+
 if __name__ == "__main__":
+    if "--lint" in sys.argv[1:]:
+        _lint_preflight()
     if "serve" in sys.argv[1:]:
         serve_main()
     else:

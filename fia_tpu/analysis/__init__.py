@@ -1,0 +1,32 @@
+"""`fialint` — repo-native static analysis for fia_tpu.
+
+An AST-based lint engine (`python -m fia_tpu.analysis.lint`) whose
+rules encode the invariants this repo actually rides on and that no
+general-purpose linter knows about:
+
+- **FIA101 raw-write discipline** — every persisted byte goes through
+  `utils/io.py` / `reliability/artifacts.py` (the fsync'd-atomic +
+  checksummed-manifest path); a raw `open(.., "w")` elsewhere is how
+  caches get torn.
+- **FIA201/202/203 trace hygiene** — host syncs, Python control flow
+  on traced values, and array closure capture inside jit-traced
+  functions are the recompile/constant-baking hazards that wreck the
+  serving path's latency.
+- **FIA301/302/303 fault-site integrity** — injection-site literals
+  must resolve to the checked-in registry
+  (`reliability/sites.py`), reliability-layer raises must be
+  classifiable, and `docs/reliability.md` must document every site.
+- **FIA401 metrics schema consistency** — the serving events emitted
+  by `serve/metrics.py` and the fields `scripts/latency_report.py`
+  consumes are cross-checked against one declared schema.
+
+See `docs/lint.md` for the rule catalog and suppression syntax
+(`# fialint: disable=FIA101 -- justification`).
+"""
+
+from fia_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    LintResult,
+    all_rules,
+    lint_paths,
+)

@@ -1,0 +1,60 @@
+"""Repo-specific lint configuration.
+
+fialint is a *repo-native* linter: its rules encode this codebase's
+invariants, so the allowlists naming which modules own which privilege
+live here — in code, reviewed like code — rather than in an external
+config file.
+"""
+
+from __future__ import annotations
+
+# FIA101: the only modules allowed to perform raw persisted writes.
+# utils/io.py owns the fsync'd-atomic primitives; reliability/artifacts.py
+# owns the checksummed-manifest publish built on top.
+RAW_WRITE_ALLOWED = (
+    "fia_tpu/utils/io.py",
+    "fia_tpu/reliability/artifacts.py",
+)
+
+# FIA2xx: jit entry points reached through indirection the AST cannot
+# follow (a method captured inside a ``vmap``/``partial`` assigned to a
+# local, then called from a jitted closure). Each entry is
+# (path suffix, bare function name); ``self`` is treated as static.
+REGISTERED_JIT_ENTRY_POINTS = (
+    # InfluenceEngine._query_one: vmapped via partial into the padded
+    # per-bucket closures that _batched/_batched_packed jit.
+    ("fia_tpu/influence/engine.py", "_query_one"),
+)
+
+# FIA302 applies to files whose repo-relative path starts with:
+RELIABILITY_PREFIX = "fia_tpu/reliability/"
+
+# FIA302: exception types the reliability layer may raise. The four
+# reliability-owned types are taxonomy-classifiable (or ARE the
+# taxonomy); the builtins are programmer-contract errors that indicate
+# a bug at the call site, not a runtime fault to classify.
+RELIABILITY_RAISABLE = frozenset({
+    "DeadlineExpired",
+    "NanPayload",
+    "ArtifactIntegrityError",
+    "JournalMismatch",
+    "ValueError",
+    "TypeError",
+    "KeyError",
+    "NotImplementedError",
+    "AssertionError",
+})
+
+# FIA301/FIA303: where the site registry and its documentation live.
+SITES_MODULE = "fia_tpu/reliability/sites.py"
+SITES_DOC = "docs/reliability.md"
+
+# FIA401: the emitted-schema and consumer declarations.
+METRICS_MODULE = "fia_tpu/serve/metrics.py"
+METRICS_CONSUMER = "scripts/latency_report.py"
+# Event-log calls checked against the schema are restricted to this
+# subtree (EventLog is also used for training curves / bench logs whose
+# ad-hoc events are not part of the serving contract).
+METRICS_SCOPE = "fia_tpu/serve/"
+# Fields every EventLog record carries implicitly.
+METRICS_IMPLICIT_FIELDS = frozenset({"t", "event"})

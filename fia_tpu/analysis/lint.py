@@ -1,0 +1,112 @@
+"""CLI entry point: ``python -m fia_tpu.analysis.lint [paths...]``.
+
+Exit codes: 0 clean, 1 findings, 2 usage error.
+
+Common invocations::
+
+    python -m fia_tpu.analysis.lint fia_tpu/            # lint the package
+    python -m fia_tpu.analysis.lint --self-check        # the tier-1 gate
+    python -m fia_tpu.analysis.lint --select FIA101 ... # one rule family
+    python -m fia_tpu.analysis.lint --json fia_tpu/     # machine-readable
+    python -m fia_tpu.analysis.lint --list-rules
+
+``--self-check`` lints the repo's own blessed surface (``fia_tpu/``,
+``scripts/``, ``bench.py``, resolved relative to the installed package)
+and must come back clean — it is wired into ``make lint``,
+``scripts/tier1.sh`` (fatal), and ``bench.py --lint``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from fia_tpu.analysis.core import all_rules, lint_paths
+from fia_tpu.analysis.reporters import (
+    json_report,
+    rule_catalog,
+    terminal_report,
+)
+
+
+def self_check_paths() -> tuple[list[str], str]:
+    """The repo's own lint surface, anchored at the package location."""
+    pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    root = os.path.dirname(pkg_dir)
+    paths = [pkg_dir]
+    for extra in ("scripts", "bench.py"):
+        p = os.path.join(root, extra)
+        if os.path.exists(p):
+            paths.append(p)
+    return paths, root
+
+
+def _parse_rule_set(spec: list[str] | None) -> set[str] | None:
+    if not spec:
+        return None
+    out: set[str] = set()
+    for chunk in spec:
+        out.update(r.strip() for r in chunk.split(",") if r.strip())
+    known = set(all_rules())
+    unknown = out - known
+    if unknown:
+        raise SystemExit(
+            f"fialint: unknown rule id(s): {', '.join(sorted(unknown))} "
+            f"(known: {', '.join(sorted(known))})"
+        )
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m fia_tpu.analysis.lint",
+        description="Repo-native static analysis for fia_tpu "
+                    "(see docs/lint.md).",
+    )
+    ap.add_argument("paths", nargs="*", help="files/directories to lint")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the JSON report instead of terminal lines")
+    ap.add_argument("--select", action="append", metavar="RULES",
+                    help="comma-separated rule ids to run exclusively")
+    ap.add_argument("--disable", action="append", metavar="RULES",
+                    help="comma-separated rule ids to skip")
+    ap.add_argument("--self-check", action="store_true",
+                    help="lint the repo's own fia_tpu/, scripts/, bench.py")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        print(rule_catalog())
+        return 0
+
+    root = None
+    paths = list(args.paths)
+    if args.self_check:
+        sc_paths, root = self_check_paths()
+        paths.extend(sc_paths)
+    if not paths:
+        ap.print_usage(sys.stderr)
+        print("fialint: no paths given (or use --self-check)",
+              file=sys.stderr)
+        return 2
+
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        print(f"fialint: no such path(s): {', '.join(missing)}",
+              file=sys.stderr)
+        return 2
+
+    result = lint_paths(
+        paths,
+        select=_parse_rule_set(args.select),
+        disable=_parse_rule_set(args.disable),
+        root=root,
+    )
+    print(json_report(result) if args.json else terminal_report(result))
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

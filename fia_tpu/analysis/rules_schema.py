@@ -1,0 +1,146 @@
+"""FIA401 — serving metrics schema consistency.
+
+``serve/metrics.py`` declares the stable event schema (``SCHEMA``:
+event name → field names) that operators build dashboards on;
+``scripts/latency_report.py`` declares what it reads (``CONSUMES``).
+This rule cross-checks the two against each other and against the
+actual ``EventLog.log(...)`` call sites in ``fia_tpu/serve/``, so a
+renamed field or a new event can't silently decouple the producer
+from the report:
+
+- every event literal logged under ``fia_tpu/serve/`` must be a
+  ``SCHEMA`` key;
+- statically visible keyword fields at those call sites must be
+  declared for that event;
+- every event/field in ``CONSUMES`` must exist in ``SCHEMA``.
+
+``t`` and ``event`` are implicit (EventLog stamps them on every
+record).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from fia_tpu.analysis import config
+from fia_tpu.analysis.core import Finding, ProjectRule, SourceFile, register
+from fia_tpu.analysis.visitor import const_str, literal_or_none
+
+
+def _load_decl(root: str, rel: str, name: str):
+    """literal_eval a module-level ``NAME = {...}`` declaration.
+
+    Returns ``(mapping, lineno)`` or ``(None, reason)``.
+    """
+    path = os.path.join(root, rel)
+    try:
+        with open(path, encoding="utf-8") as fh:
+            tree = ast.parse(fh.read(), filename=path)
+    except (OSError, SyntaxError) as e:
+        return None, f"{rel} unreadable ({e.__class__.__name__})"
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and (
+            isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id == name
+        ):
+            value = literal_or_none(node.value)
+            if isinstance(value, dict):
+                return (
+                    {str(k): frozenset(v) for k, v in value.items()},
+                    node.lineno,
+                )
+            return None, f"{rel}:{node.lineno} {name} is not a literal dict"
+    return None, f"{rel} declares no module-level {name}"
+
+
+def _log_calls(sf: SourceFile):
+    """(node, event_literal, visible_kwarg_names) for EventLog-style
+    ``*.log("event.name", field=...)`` calls."""
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if not (isinstance(fn, ast.Attribute) and fn.attr == "log"):
+            continue
+        if not node.args:
+            continue
+        event = const_str(node.args[0])
+        if event is None or "." not in event:
+            continue  # not a schema'd serving event
+        kwargs = {kw.arg for kw in node.keywords if kw.arg is not None}
+        yield node, event, kwargs
+
+
+@register
+class MetricsSchemaRule(ProjectRule):
+    """Emitted serving events and the latency report must agree."""
+
+    id = "FIA401"
+    name = "metrics-schema-drift"
+
+    def check_project(self, files: list[SourceFile], root: str):
+        findings: list[Finding] = []
+        in_scope = [
+            sf for sf in files
+            if sf.tree is not None and config.METRICS_SCOPE in sf.rel
+        ]
+        schema, schema_ref = _load_decl(
+            root, config.METRICS_MODULE, "SCHEMA"
+        )
+        if schema is None:
+            # only demand the declaration when serving code is actually
+            # being linted — foreign trees have no serving schema
+            if in_scope:
+                findings.append(Finding(
+                    self.id, config.METRICS_MODULE, 1, 0,
+                    "missing serving metrics schema declaration: "
+                    f"{schema_ref}",
+                ))
+            return findings
+        implicit = config.METRICS_IMPLICIT_FIELDS
+
+        # producer side: every .log("x.y", ...) in fia_tpu/serve/
+        for sf in in_scope:
+            for node, event, kwargs in _log_calls(sf):
+                if event not in schema:
+                    findings.append(Finding(
+                        self.id, sf.rel, node.lineno, node.col_offset,
+                        f"event {event!r} is not declared in "
+                        f"{config.METRICS_MODULE} SCHEMA",
+                    ))
+                    continue
+                undeclared = sorted(kwargs - schema[event] - implicit)
+                if undeclared:
+                    findings.append(Finding(
+                        self.id, sf.rel, node.lineno, node.col_offset,
+                        f"event {event!r} emits undeclared field(s) "
+                        f"{', '.join(undeclared)} (add to SCHEMA or drop)",
+                    ))
+
+        # consumer side: latency_report's CONSUMES ⊆ SCHEMA
+        consumes, c_ref = _load_decl(
+            root, config.METRICS_CONSUMER, "CONSUMES"
+        )
+        if consumes is None:
+            findings.append(Finding(
+                self.id, config.METRICS_CONSUMER, 1, 0,
+                f"missing consumer declaration: {c_ref}",
+            ))
+            return findings
+        for event, fields in sorted(consumes.items()):
+            if event not in schema:
+                findings.append(Finding(
+                    self.id, config.METRICS_CONSUMER, 1, 0,
+                    f"latency report consumes unknown event {event!r}",
+                ))
+                continue
+            missing = sorted(set(fields) - schema[event] - implicit)
+            if missing:
+                findings.append(Finding(
+                    self.id, config.METRICS_CONSUMER, 1, 0,
+                    f"latency report consumes field(s) "
+                    f"{', '.join(missing)} that {event!r} does not emit "
+                    f"(SCHEMA at {config.METRICS_MODULE}:{schema_ref})",
+                ))
+        return findings

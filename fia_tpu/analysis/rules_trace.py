@@ -1,0 +1,264 @@
+"""FIA201/202/203 — trace hygiene inside jit-traced functions.
+
+The serving path's latency contract rests on the pad-bucket discipline:
+every hot dispatch reuses a compiled program. The three ways that
+silently breaks are all *visible in the AST* of a traced function:
+
+- **FIA201 host sync** — ``float()`` / ``.item()`` / ``np.*`` /
+  ``print`` on a traced value blocks the host on the device (or worse,
+  silently constant-folds at trace time and ships a stale value).
+- **FIA202 traced branch** — Python ``if``/``while`` on an
+  array-valued expression either raises a ``TracerBoolConversionError``
+  on device or — when the value happens to be concrete at trace time —
+  bakes one branch into the compiled program and recompiles when the
+  operand bucket changes.
+- **FIA203 array closure capture** — a numpy array captured by a
+  jitted closure is baked into the executable as a constant: a new
+  compile (and a duplicated on-device buffer) per distinct captured
+  array, which is exactly the recompile storm the engine's
+  ``_jitted[pad]`` cache exists to prevent. Arrays must flow through
+  the traced argument list.
+
+Jit scopes are detected per the module docstring of
+:class:`fia_tpu.analysis.visitor.JitIndex`; entry points reached
+through indirection are registered in ``config``. Detection is
+necessarily heuristic (no type inference): names assigned from
+``jnp.*``/``jax.*`` expressions or derived from traced parameters are
+treated as traced. False positives are suppressed inline with a
+justification, which doubles as documentation of *why* the flagged
+line is actually safe.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from fia_tpu.analysis.core import FileRule, Finding, SourceFile, register
+from fia_tpu.analysis.visitor import (
+    call_name,
+    dotted_name,
+    iter_jitted_defs,
+)
+
+_ARRAY_MODULES = ("jnp", "jax", "lax")
+_HOST_MODULES = ("np", "numpy", "onp")
+
+
+def _names_in(node: ast.AST) -> set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _is_none_check(test: ast.AST) -> bool:
+    """``x is None`` / ``x is not None`` — a static-arg idiom, never a
+    device sync (a traced operand cannot be None)."""
+    return (
+        isinstance(test, ast.Compare)
+        and len(test.ops) == 1
+        and isinstance(test.ops[0], (ast.Is, ast.IsNot))
+    )
+
+
+def _roots(node: ast.AST) -> set[str]:
+    """Root names of every Name/Attribute chain in an expression."""
+    out: set[str] = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name):
+            out.add(n.id)
+    return out
+
+
+class _TraceScope:
+    """Dataflow over one jitted def: which local names are traced."""
+
+    def __init__(self, fn: ast.FunctionDef, traced_params: set[str]):
+        self.fn = fn
+        self.traced: set[str] = set(traced_params)
+
+    def expr_is_traced(self, node: ast.AST) -> bool:
+        for n in ast.walk(node):
+            if isinstance(n, ast.Name) and n.id in self.traced:
+                return True
+            if isinstance(n, ast.Call):
+                cn = call_name(n)
+                if cn and cn.split(".", 1)[0] in _ARRAY_MODULES:
+                    return True
+        return False
+
+    def note_assign(self, node: ast.AST) -> None:
+        if isinstance(node, ast.Assign) and self.expr_is_traced(node.value):
+            for tgt in node.targets:
+                for n in ast.walk(tgt):
+                    if isinstance(n, ast.Name):
+                        self.traced.add(n.id)
+        elif isinstance(node, ast.AugAssign) and isinstance(
+            node.target, ast.Name
+        ):
+            if self.expr_is_traced(node.value) or (
+                node.target.id in self.traced
+            ):
+                self.traced.add(node.target.id)
+
+
+def _walk_in_order(fn: ast.FunctionDef):
+    """Source-order walk of a def's body, skipping nested defs (they
+    get their own scope when jitted; when not jitted they still trace,
+    but their params shadow — handled conservatively by skipping)."""
+    def rec(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            yield child
+            yield from rec(child)
+    for stmt in fn.body:
+        yield stmt
+        yield from rec(stmt)
+
+
+@register
+class HostSyncRule(FileRule):
+    """Host-sync hazards inside jit-traced functions."""
+
+    id = "FIA201"
+    name = "host-sync-in-jit"
+
+    def check(self, sf: SourceFile):
+        findings: list[Finding] = []
+        for fn, idx, _ in iter_jitted_defs(sf):
+            scope = _TraceScope(fn, idx.traced_params(fn))
+            for node in _walk_in_order(fn):
+                scope.note_assign(node)
+                if not isinstance(node, ast.Call):
+                    continue
+                cn = call_name(node)
+                msg = None
+                if cn == "print":
+                    msg = ("print() inside a jit-traced function runs at "
+                           "trace time only (use jax.debug.print)")
+                elif cn in ("float", "int", "bool") and node.args and (
+                    scope.expr_is_traced(node.args[0])
+                ):
+                    msg = (f"{cn}() on a traced value forces a host sync "
+                           "(TracerConversionError on device)")
+                elif cn and cn.split(".", 1)[0] in _HOST_MODULES:
+                    msg = (f"host numpy call {cn}() inside a jit-traced "
+                           "function (constant-folds at trace time or "
+                           "fails on tracers; use jnp)")
+                elif cn in ("jax.device_get",):
+                    msg = "jax.device_get inside a jit-traced function"
+                elif isinstance(node.func, ast.Attribute) and (
+                    node.func.attr in ("item", "tolist",
+                                       "block_until_ready")
+                    and not node.args
+                ):
+                    msg = (f".{node.func.attr}() forces a host sync "
+                           "inside a jit-traced function")
+                if msg:
+                    findings.append(Finding(
+                        self.id, sf.rel, node.lineno, node.col_offset, msg
+                    ))
+        return findings
+
+
+@register
+class TracedBranchRule(FileRule):
+    """Python control flow on traced values inside jit scopes."""
+
+    id = "FIA202"
+    name = "traced-branch"
+
+    def check(self, sf: SourceFile):
+        findings: list[Finding] = []
+        for fn, idx, _ in iter_jitted_defs(sf):
+            scope = _TraceScope(fn, idx.traced_params(fn))
+            for node in _walk_in_order(fn):
+                scope.note_assign(node)
+                if not isinstance(node, (ast.If, ast.While)):
+                    continue
+                test = node.test
+                if _is_none_check(test):
+                    continue
+                if isinstance(test, ast.Call) and call_name(test) in (
+                    "isinstance", "hasattr", "callable", "len"
+                ):
+                    continue
+                hits = sorted(_roots(test) & scope.traced)
+                if hits:
+                    kw = "while" if isinstance(node, ast.While) else "if"
+                    findings.append(Finding(
+                        self.id, sf.rel, node.lineno, node.col_offset,
+                        f"Python `{kw}` on traced value(s) "
+                        f"{', '.join(hits)} (bakes one branch into the "
+                        "compiled program; use jnp.where/lax.cond)",
+                    ))
+        return findings
+
+
+def _enclosing_array_bindings(enclosing: ast.FunctionDef) -> set[str]:
+    """Names bound in the enclosing scope whose value is (or derives
+    from) a host numpy call — the constant-baking capture hazard."""
+    out: set[str] = set()
+    for node in ast.walk(enclosing):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and (
+            node is not enclosing
+        ):
+            continue
+        if isinstance(node, ast.Assign):
+            derives = False
+            for n in ast.walk(node.value):
+                if isinstance(n, ast.Call):
+                    cn = call_name(n)
+                    if cn and cn.split(".", 1)[0] in _HOST_MODULES:
+                        derives = True
+                if isinstance(n, ast.Name) and n.id in out:
+                    derives = True
+            if derives:
+                for tgt in node.targets:
+                    for n in ast.walk(tgt):
+                        if isinstance(n, ast.Name):
+                            out.add(n.id)
+    return out
+
+
+@register
+class ClosureCaptureRule(FileRule):
+    """Numpy arrays captured by jitted closures get baked as constants."""
+
+    id = "FIA203"
+    name = "array-closure-capture"
+
+    def check(self, sf: SourceFile):
+        findings: list[Finding] = []
+        for fn, idx, enclosing in iter_jitted_defs(sf):
+            if enclosing is None:
+                continue
+            local: set[str] = idx.traced_params(fn) | {"self"}
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign):
+                    for tgt in node.targets:
+                        for n in ast.walk(tgt):
+                            if isinstance(n, ast.Name):
+                                local.add(n.id)
+            hazards = _enclosing_array_bindings(enclosing)
+            flagged: set[str] = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Name) and isinstance(
+                    node.ctx, ast.Load
+                ):
+                    if (node.id in hazards and node.id not in local
+                            and node.id not in flagged):
+                        flagged.add(node.id)
+            if flagged:
+                # one finding per closure, anchored at its def line, so
+                # a single justified suppression covers the whole
+                # capture set when the baking is deliberate
+                findings.append(Finding(
+                    self.id, sf.rel, fn.lineno, fn.col_offset,
+                    f"jitted closure {fn.name!r} captures host "
+                    f"array(s) {', '.join(sorted(flagged))} from the "
+                    "enclosing scope — baked into the compiled program "
+                    "as constants (a recompile + duplicated device "
+                    "buffer per distinct array); pass them as traced "
+                    "arguments",
+                ))
+        return findings

@@ -1,0 +1,199 @@
+"""Visitor framework and shared AST helpers for fialint rules.
+
+Rules subclass :class:`RuleVisitor` (an ``ast.NodeVisitor`` carrying
+the :class:`~fia_tpu.analysis.core.SourceFile` and a findings sink) or
+use the free helpers directly. The jit-scope machinery lives here too
+because three trace-hygiene rules share it.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from fia_tpu.analysis.core import Finding, SourceFile
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(node: ast.Call) -> str | None:
+    """Dotted name of a call's callee, else None (lambdas, subscripts)."""
+    return dotted_name(node.func)
+
+
+def const_str(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def literal_or_none(node: ast.AST):
+    try:
+        return ast.literal_eval(node)
+    except (ValueError, SyntaxError):
+        return None
+
+
+class RuleVisitor(ast.NodeVisitor):
+    """NodeVisitor with the source file and a findings sink attached."""
+
+    def __init__(self, rule_id: str, sf: SourceFile):
+        self.rule_id = rule_id
+        self.sf = sf
+        self.findings: list[Finding] = []
+
+    def flag(self, node: ast.AST, message: str) -> None:
+        self.findings.append(Finding(
+            self.rule_id, self.sf.rel,
+            getattr(node, "lineno", 1), getattr(node, "col_offset", 0),
+            message,
+        ))
+
+    def run(self) -> list[Finding]:
+        if self.sf.tree is not None:
+            self.visit(self.sf.tree)
+        return self.findings
+
+
+# ---------------------------------------------------------------------
+# jit-scope detection (shared by the FIA2xx trace-hygiene rules)
+# ---------------------------------------------------------------------
+
+_JIT_CALLEES = {
+    "jax.jit", "jit", "pjit", "jax.pjit", "pjit.pjit",
+    "jax.experimental.pjit.pjit",
+}
+# wrappers whose first positional argument is (eventually) the traced fn
+_UNWRAP_CALLEES = {
+    "jax.vmap", "vmap", "jax.pmap", "pmap", "partial",
+    "functools.partial", "jax.grad", "grad", "jax.value_and_grad",
+    "value_and_grad", "jax.checkpoint", "jax.remat",
+}
+
+
+def _terminal_fn_name(node: ast.AST) -> str | None:
+    """Unwrap ``vmap(partial(self._f, ...))`` chains to the innermost
+    function's bare name (``_f``)."""
+    while isinstance(node, ast.Call):
+        cn = call_name(node)
+        if cn in _UNWRAP_CALLEES and node.args:
+            node = node.args[0]
+            continue
+        return None
+    name = dotted_name(node)
+    if name is None:
+        return None
+    return name.split(".")[-1]
+
+
+def _static_argnums_of(call: ast.Call) -> tuple[int, ...]:
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            v = literal_or_none(kw.value)
+            if isinstance(v, int):
+                return (v,)
+            if isinstance(v, (tuple, list)):
+                return tuple(int(x) for x in v if isinstance(x, int))
+    return ()
+
+
+class JitIndex:
+    """Which function defs in a module are jit-traced, and with which
+    static argument positions.
+
+    Detected, in one AST pass over the module:
+
+    - defs decorated ``@jax.jit`` / ``@partial(jax.jit, ...)``;
+    - defs whose *name* is passed (possibly through ``vmap`` /
+      ``partial`` / ``grad`` wrappers) into a ``jax.jit(...)`` call
+      anywhere in the module (the engine's ``self._jitted[k] =
+      jax.jit(fn)`` idiom);
+    - names registered in ``config.REGISTERED_JIT_ENTRY_POINTS`` for
+      this file — entry points reached through indirection the AST
+      cannot see (e.g. a method referenced only inside a ``vmap``
+      assigned to a local that a later jitted closure calls).
+    """
+
+    def __init__(self, sf: SourceFile):
+        from fia_tpu.analysis import config
+
+        self.jitted_names: dict[str, tuple[int, ...]] = {}
+        for suffix, name in config.REGISTERED_JIT_ENTRY_POINTS:
+            if sf.rel.endswith(suffix):
+                self.jitted_names[name] = (0,)  # bound method: self static
+        if sf.tree is None:
+            return
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Call) and call_name(node) in _JIT_CALLEES:
+                if node.args:
+                    fn = _terminal_fn_name(node.args[0])
+                    if fn:
+                        self.jitted_names.setdefault(
+                            fn, _static_argnums_of(node)
+                        )
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    statics: tuple[int, ...] | None = None
+                    if isinstance(dec, ast.Call):
+                        cn = call_name(dec)
+                        if cn in _JIT_CALLEES:
+                            statics = _static_argnums_of(dec)
+                        elif cn in ("partial", "functools.partial") and (
+                            dec.args
+                            and dotted_name(dec.args[0]) in _JIT_CALLEES
+                        ):
+                            statics = _static_argnums_of(dec)
+                    elif dotted_name(dec) in _JIT_CALLEES:
+                        statics = ()
+                    if statics is not None:
+                        self.jitted_names[node.name] = statics
+
+    def is_jitted(self, fn: ast.FunctionDef | ast.AsyncFunctionDef) -> bool:
+        return fn.name in self.jitted_names
+
+    def traced_params(
+        self, fn: ast.FunctionDef | ast.AsyncFunctionDef
+    ) -> set[str]:
+        """Parameter names traced at call time: positional/kw params
+        minus ``self`` and the declared static positions. Vararg packs
+        are traced (``fn(*a)`` receives traced operands)."""
+        statics = set(self.jitted_names.get(fn.name, ()))
+        params = list(fn.args.posonlyargs) + list(fn.args.args)
+        traced: set[str] = set()
+        for i, a in enumerate(params):
+            if i in statics or a.arg == "self":
+                continue
+            traced.add(a.arg)
+        for a in fn.args.kwonlyargs:
+            traced.add(a.arg)
+        if fn.args.vararg:
+            traced.add(fn.args.vararg.arg)
+        return traced
+
+
+def iter_jitted_defs(sf: SourceFile):
+    """Yield ``(funcdef, jit_index, enclosing_funcdef_or_None)`` for
+    every jit-traced def in the file."""
+    idx = JitIndex(sf)
+    if sf.tree is None or not idx.jitted_names:
+        return
+
+    def walk(node, enclosing):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if idx.is_jitted(child):
+                    yield child, idx, enclosing
+                yield from walk(child, child)
+            else:
+                yield from walk(child, enclosing)
+
+    yield from walk(sf.tree, None)

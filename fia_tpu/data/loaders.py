@@ -18,6 +18,7 @@ import os
 import numpy as np
 
 from fia_tpu.data import native
+from fia_tpu.utils import io
 from fia_tpu.data.dataset import RatingDataset
 from fia_tpu.data.synthetic import synthesize_calibrated, synthesize_ratings
 
@@ -41,7 +42,7 @@ def _read_tsv(path: str, n_rows: int | None) -> RatingDataset:
 
 def save_tsv(ds: RatingDataset, path: str) -> None:
     out = np.concatenate([ds.x.astype(np.int64), ds.y.reshape(-1, 1)], axis=1)
-    np.savetxt(path, out, fmt=["%d", "%d", "%g"], delimiter="\t")
+    io.savetxt_atomic(path, out, fmt=["%d", "%d", "%g"], delimiter="\t")
 
 
 def load_dataset(

@@ -36,7 +36,7 @@ from fia_tpu.data.index import InteractionIndex, bucketed_pad
 from fia_tpu.influence import grads as G
 from fia_tpu.influence import hvp as H
 from fia_tpu.influence import solvers
-from fia_tpu.reliability import inject, taxonomy
+from fia_tpu.reliability import inject, sites, taxonomy
 from fia_tpu.reliability import policy as rpolicy
 from fia_tpu.reliability.journal import Journal  # noqa: F401 (re-export)
 
@@ -372,7 +372,7 @@ class InfluenceEngine:
         every jit operand must be a global array; params (unless
         table-sharded) and train tensors are replicated.
         """
-        inject.fire("engine.upload")
+        inject.fire(sites.ENGINE_UPLOAD)
         mesh = self.mesh
         self.params = jax.tree_util.tree_map(jnp.asarray, self._params_host)
         if self._shard_tables:
@@ -817,7 +817,7 @@ class InfluenceEngine:
         """Enqueue one flat query program; returns an opaque handle for
         :meth:`_finalize_flat`. Dispatch is async — the device starts
         crunching while the host moves on."""
-        inject.fire("engine.dispatch_flat")
+        inject.fire(sites.ENGINE_DISPATCH_FLAT)
         counts = self.index.counts_batch(test_points)
         total = int(counts.sum())
         # geometric bucketing (~12.5% granule): pure powers of two waste
@@ -1129,7 +1129,7 @@ class InfluenceEngine:
         # NaN injection site: a diverged solve returns a "successful"
         # buffer — corruption (and detection) happens on the fetched
         # host payload, exactly like the real failure mode.
-        ihvp = inject.corrupt("engine.solve", np.asarray(ihvp))
+        ihvp = inject.corrupt(sites.ENGINE_SOLVE, np.asarray(ihvp))
         total = int(counts.sum())
         return InfluenceResult(
             counts=counts,
@@ -1525,7 +1525,7 @@ class InfluenceEngine:
         batch's related-row total); chunked dispatches of one batch
         pass a common value so they share one compiled program.
         """
-        inject.fire("engine.dispatch_padded")
+        inject.fire(sites.ENGINE_DISPATCH_PADDED)
         counts = self.index.counts_batch(test_points)
         m = counts.max() if counts.size else 1
         if pad_to is None and self.pad_policy == "dataset":
@@ -1577,7 +1577,7 @@ class InfluenceEngine:
             )
         else:
             scores, ihvp, v = jax.device_get(out)
-        ihvp = inject.corrupt("engine.solve", np.asarray(ihvp))
+        ihvp = inject.corrupt(sites.ENGINE_SOLVE, np.asarray(ihvp))
         # Result row ids/mask come from the host CSR (same ordering as the
         # device gather: user postings then item postings) — cheap, and it
         # avoids shipping (T, P) int/bool arrays back over the interconnect.
@@ -1651,7 +1651,7 @@ class InfluenceEngine:
                      params_fp=self._params_fingerprint()),
                 fingerprint={"model_key": self.model_name,
                              "solver": self.solver},
-                site="engine.cache_publish",
+                site=sites.ENGINE_CACHE_PUBLISH,
             )
         return res.scores_of(0)
 

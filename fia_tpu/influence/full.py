@@ -28,7 +28,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from fia_tpu.data.dataset import RatingDataset
 from fia_tpu.influence import solvers
-from fia_tpu.reliability import inject
+from fia_tpu.reliability import inject, sites
 from fia_tpu.reliability import policy as rpolicy
 
 
@@ -279,7 +279,7 @@ class FullInfluenceEngine:
                             self.train_x, self.train_y, solver)
             # fault-injection site: corrupts the *screened* host copy,
             # so recovery runs exactly as for a real diverged solve
-            xh = inject.corrupt("full.solve", np.asarray(self._fetch(x)))
+            xh = inject.corrupt(sites.FULL_SOLVE, np.asarray(self._fetch(x)))
             bad = not np.isfinite(xh).all()
             reason = "non-finite inverse-HVP"
             if not bad and self.residual_guard is not None:

@@ -37,7 +37,7 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from fia_tpu.reliability import inject, taxonomy
+from fia_tpu.reliability import inject, sites, taxonomy
 from fia_tpu.reliability import policy as rpolicy
 
 # Per-array placement retry (see put_global): short delays — the engine's
@@ -276,7 +276,7 @@ def put_global(mesh: Mesh, tree, spec: P):
 
     def put(x):
         def place():
-            inject.fire("distributed.put_global")
+            inject.fire(sites.DISTRIBUTED_PUT_GLOBAL)
             if local:
                 # device_put reshards on-device; forcing np.asarray here
                 # would round-trip already-device-resident params

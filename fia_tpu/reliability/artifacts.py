@@ -50,7 +50,7 @@ import tempfile
 
 import numpy as np
 
-from fia_tpu.reliability import inject
+from fia_tpu.reliability import inject, sites
 from fia_tpu.reliability.journal import pack
 
 MAGIC = "fia-artifact-v1"
@@ -130,7 +130,7 @@ def publish_npz(
     arrays: dict,
     *,
     fingerprint=None,
-    site: str = "artifacts.publish",
+    site: str = sites.ARTIFACTS_PUBLISH,
 ) -> str:
     """Durably publish ``arrays`` as an npz at ``path`` with a manifest.
 

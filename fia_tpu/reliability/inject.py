@@ -22,24 +22,12 @@ signatures (the r3/r4 worker-death and tunnel-500 strings), so the
 taxonomy classifies injected faults exactly like real ones — the test
 never talks to the classifier directly.
 
-Sites currently wired (grep for ``inject.fire``/``inject.corrupt``/
-``inject.damage``):
-
-==========================  ================================================
-``engine.upload``           device-state (re)build in ``_upload_device_state``
-``engine.dispatch_flat``    flat-path query dispatch
-``engine.dispatch_padded``  padded-path query dispatch
-``engine.solve``            fetched iHVP payload (``kind="nan"`` corrupts)
-``full.solve``              FullInfluenceEngine fetched solve (``kind="nan"``)
-``trainer.epoch``           one compiled-epoch dispatch in ``Trainer.fit``
-``trainer.loo_segment``     one LOO retraining segment dispatch
-``distributed.put_global``  global-array placement
-``artifacts.publish``       generic artifact publish (``damage`` kinds)
-``checkpoint.publish``      one rotated/terminal checkpoint publish
-``engine.cache_publish``    one inverse-HVP cache entry publish
-``serve.dispatch``          one micro-batch device dispatch in the service
-``serve.cache_publish``     one serving-tier disk cache entry publish
-==========================  ================================================
+Site names are declared once in :mod:`fia_tpu.reliability.sites`
+(production call sites use the constants; the repo linter's ``FIA301``
+rule rejects any literal that is not registered there) and documented
+with per-site descriptions in ``docs/reliability.md`` ("Injection-site
+registry" — lint rule ``FIA303`` and ``tests/test_analysis.py`` keep
+that table in sync with the registry).
 
 On-disk corruption kinds (fired through :func:`damage`, applied AFTER a
 publish completes so the atomic-write path itself stays honest):
@@ -160,6 +148,7 @@ class Injector:
         f.fired = True
         self.log.append((site, idx, f.kind))
         if f.kind == taxonomy.HOST_OOM:
+            # fialint: disable=FIA302 -- injected host-OOM must carry the raw MemoryError signature so the taxonomy classifies it like a real one
             raise MemoryError(f.message or "injected host allocation failure")
         if f.kind == taxonomy.DEADLINE:
             raise taxonomy.DeadlineExpired(
@@ -168,6 +157,7 @@ class Injector:
         msg = f.message or MESSAGES.get(f.kind)
         if msg is None:
             raise ValueError(f"no synthetic signature for kind {f.kind!r}")
+        # fialint: disable=FIA302 -- injected device faults replay raw production RuntimeError signatures verbatim; wrapping them would defeat the classifier under test
         raise RuntimeError(msg)
 
     def corrupt(self, site: str, array):
@@ -209,7 +199,9 @@ class Injector:
             with open(manifest_path) as fh:
                 m = json.load(fh)
             m["checksum"] = "sha256:" + "0" * 64
+            # fialint: disable=FIA101 -- deliberate corruption: the fault injector must bypass the atomic-write layer to plant a stale manifest
             with open(manifest_path, "w") as fh:
+                # fialint: disable=FIA101 -- part of the same deliberate corruption write
                 json.dump(m, fh)
 
     def unfired(self) -> list[Fault]:
@@ -261,6 +253,7 @@ def active(*faults: Fault):
     """
     global _active
     if _active is not None:
+        # fialint: disable=FIA302 -- nesting misuse is a harness bug, not a classifiable fault; tests pin the RuntimeError type
         raise RuntimeError("a fault-injection plan is already armed")
     inj = Injector(faults)
     _active = inj

@@ -1,0 +1,84 @@
+"""The checked-in fault-injection site registry.
+
+Every named injection point in the production code — the ``site=``
+strings passed to :func:`fia_tpu.reliability.inject.fire` /
+``inject.corrupt`` / ``inject.damage`` and to
+:func:`fia_tpu.reliability.artifacts.publish_npz` — is declared here,
+once, as a module constant. Call sites reference the constants; tests
+may still use the raw strings (a ``Fault`` plan reads like the failure
+it scripts), but **every literal must resolve to a name in this
+registry**: rule ``FIA301`` of the repo linter
+(``python -m fia_tpu.analysis.lint``) flags any site string that does
+not appear in :data:`ALL_SITES`, and ``FIA303`` plus
+``tests/test_analysis.py`` assert ``docs/reliability.md`` documents
+every registered site.
+
+Why a registry instead of grep: a typo'd site name used to fail
+*silently* — ``inject.fire("engine.dipsatch_flat")`` is a perfectly
+valid no-op call, so the fault plan armed against the real site never
+fires and the test passes without exercising the recovery path it
+thinks it covers. With the registry, the typo is a lint error at the
+call site and an ``unknown site`` error when a plan is armed.
+
+Adding a site: define the constant, add it to the table in
+``docs/reliability.md`` (section "Injection-site registry"), and use
+the constant at the call site. The linter enforces both halves.
+"""
+
+from __future__ import annotations
+
+# -- engine query path -------------------------------------------------
+ENGINE_UPLOAD = "engine.upload"
+ENGINE_DISPATCH_FLAT = "engine.dispatch_flat"
+ENGINE_DISPATCH_PADDED = "engine.dispatch_padded"
+ENGINE_SOLVE = "engine.solve"
+ENGINE_CACHE_PUBLISH = "engine.cache_publish"
+
+# -- full-parameter engine ---------------------------------------------
+FULL_SOLVE = "full.solve"
+
+# -- training ----------------------------------------------------------
+TRAINER_EPOCH = "trainer.epoch"
+TRAINER_LOO_SEGMENT = "trainer.loo_segment"
+CHECKPOINT_PUBLISH = "checkpoint.publish"
+
+# -- distributed runtime -----------------------------------------------
+DISTRIBUTED_PUT_GLOBAL = "distributed.put_global"
+
+# -- artifact integrity layer ------------------------------------------
+ARTIFACTS_PUBLISH = "artifacts.publish"
+
+# -- serving -----------------------------------------------------------
+SERVE_DISPATCH = "serve.dispatch"
+SERVE_CACHE_PUBLISH = "serve.cache_publish"
+
+ALL_SITES = frozenset({
+    ENGINE_UPLOAD,
+    ENGINE_DISPATCH_FLAT,
+    ENGINE_DISPATCH_PADDED,
+    ENGINE_SOLVE,
+    ENGINE_CACHE_PUBLISH,
+    FULL_SOLVE,
+    TRAINER_EPOCH,
+    TRAINER_LOO_SEGMENT,
+    CHECKPOINT_PUBLISH,
+    DISTRIBUTED_PUT_GLOBAL,
+    ARTIFACTS_PUBLISH,
+    SERVE_DISPATCH,
+    SERVE_CACHE_PUBLISH,
+})
+
+
+def check(site: str) -> str:
+    """Validate ``site`` against the registry; returns it unchanged.
+
+    For callers that construct site names dynamically (the linter can
+    only see literals): raising here turns a plan that could never fire
+    into a loud error instead of a test that silently stops testing.
+    """
+    if site not in ALL_SITES:
+        raise ValueError(
+            f"unknown injection site {site!r}; registered sites live in "
+            "fia_tpu/reliability/sites.py"
+        )
+    return site

@@ -28,6 +28,8 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from fia_tpu.reliability import sites
+
 
 @dataclass
 class CacheStats:
@@ -197,5 +199,5 @@ def disk_put(path: str, entry: BlockEntry, fingerprint: dict) -> None:
             count=np.asarray(entry.count, np.int64),
         ),
         fingerprint=fingerprint,
-        site="serve.cache_publish",
+        site=sites.SERVE_CACHE_PUBLISH,
     )

@@ -36,6 +36,28 @@ import numpy as np
 from fia_tpu.serve.request import Response
 from fia_tpu.utils.logging import EventLog
 
+# The declared event schema — THE stable surface operators build
+# dashboards on. Every EventLog emit under fia_tpu/serve/ and every
+# field scripts/latency_report.py consumes (its CONSUMES declaration)
+# is cross-checked against this dict by lint rule FIA401, so a renamed
+# field is a lint error instead of a silently empty report column.
+# (`t` and `event` are implicit on every record; keep this a literal
+# dict — the linter reads it with ast.literal_eval.)
+SCHEMA = {
+    "serve.request": (
+        "id", "user", "item", "status", "reason", "tier",
+        "queue_wait_ms", "solve_ms", "batch_id", "batch_size",
+    ),
+    "serve.batch": (
+        "batch_id", "size", "total_rows", "solve_ms", "status",
+    ),
+    "serve.rollup": (
+        "requests", "ok", "rejected", "tiers", "hot_hit_rate",
+        "queue_wait_ms", "solve_ms", "batches", "mean_batch_size",
+        "cache",
+    ),
+}
+
 
 def _pcts(values: list[float]) -> dict:
     if not values:

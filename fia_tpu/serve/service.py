@@ -33,7 +33,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
-from fia_tpu.reliability import inject, taxonomy
+from fia_tpu.reliability import inject, sites, taxonomy
 from fia_tpu.serve.admission import REASON_DEADLINE, AdmissionController
 from fia_tpu.serve import cache as scache
 from fia_tpu.serve.cache import BlockEntry, HotBlockCache
@@ -232,7 +232,7 @@ class InfluenceService:
             self.dispatch_log.append((bid, np.array(bpts)))
             t0 = self.clock()
             try:
-                inject.fire("serve.dispatch")
+                inject.fire(sites.SERVE_DISPATCH)
                 res = eng.query_batch(bpts)
             except Exception as e:
                 kind = taxonomy.classify(e)

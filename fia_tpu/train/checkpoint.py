@@ -33,7 +33,7 @@ import re
 import jax
 import numpy as np
 
-from fia_tpu.reliability import artifacts
+from fia_tpu.reliability import artifacts, sites
 
 _GEN_RE = re.compile(r"^ckpt-(\d+)\.npz$")
 
@@ -57,7 +57,7 @@ def save(path: str, params, opt_state=None, step: int = 0,
     payload["__step__"] = np.array(step)
     out = path if path.endswith(".npz") else path + ".npz"
     artifacts.publish_npz(out, payload, fingerprint=fingerprint,
-                          site="checkpoint.publish")
+                          site=sites.CHECKPOINT_PUBLISH)
     return out
 
 

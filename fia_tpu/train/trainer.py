@@ -22,7 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from fia_tpu.reliability import inject, taxonomy
+from fia_tpu.reliability import inject, sites, taxonomy
 from fia_tpu.reliability import policy as rpolicy
 
 # Transient device failures (worker crash/restart, preemption, tunnel
@@ -238,7 +238,7 @@ class Trainer:
 
             def dispatch_epoch(params=params, opt_state=opt_state,
                                ekey=ekey, r=r, todo=todo):
-                inject.fire("trainer.epoch")
+                inject.fire(sites.TRAINER_EPOCH)
                 return epoch_fn(
                     params, opt_state, x, y, w, ekey,
                     jnp.int32(r), jnp.int32(r + todo),
@@ -465,7 +465,7 @@ def loo_retrain_many(
         seg = keys[:, start : start + seg_epochs]
 
         def dispatch_seg(params=params, opt_state=opt_state, t=t, seg=seg):
-            inject.fire("trainer.loo_segment")
+            inject.fire(sites.TRAINER_LOO_SEGMENT)
             out = adv(params, opt_state, t, removed, seg, x, y)
             jax.block_until_ready(out[2])
             return out

@@ -3,13 +3,16 @@
 This module owns the low-level durable-write primitives; the integrity
 layer on top (checksums, manifests, quarantine) is
 ``fia_tpu/reliability/artifacts.py``. New artifact writers should go
-through that layer — ``scripts/check_raw_writes.sh`` flags raw
-``np.savez`` / ``open(.., "wb")`` writes anywhere else.
+through that layer — lint rule ``FIA101``
+(``python -m fia_tpu.analysis.lint``, wired into ``make lint-io`` and
+tier-1) flags raw ``open(.., "w")`` / ``np.save*`` / ``json.dump`` /
+``Path.write_*`` calls anywhere else.
 """
 
 from __future__ import annotations
 
 import hashlib
+import json
 import os
 import re
 import tempfile
@@ -23,6 +26,8 @@ import numpy as np
 _TMP_PATTERNS = (
     re.compile(r"^\.npztmp\.(\d+)\..*\.npz$"),
     re.compile(r"\.tmp\.(\d+)\.npz$"),
+    re.compile(r"^\.jsontmp\.(\d+)\..*\.json$"),
+    re.compile(r"^\.txttmp\.(\d+)\..*\.txt$"),
     re.compile(r"^\.manifest-tmp\.(\d*).*\.json$"),  # pid-less: see sweep
 )
 
@@ -82,6 +87,63 @@ def save_npz_atomic(path: str, **arrays) -> tuple[str, str, int]:
         raise
     fsync_dir(d)
     return path, sha, size
+
+
+def _write_atomic(path: str, prefix: str, suffix: str, write_fn) -> str:
+    """Shared fsync'd temp-write + atomic-rename dance.
+
+    ``write_fn(file_object)`` produces the bytes; the temp name embeds
+    the writer's pid so :func:`sweep_stale_tmps` can reap droppings
+    from a killed writer.
+    """
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(
+        dir=d, prefix=f"{prefix}{os.getpid()}.", suffix=suffix
+    )
+    try:
+        with os.fdopen(fd, "w") as f:
+            write_fn(f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+    fsync_dir(d)
+    return path
+
+
+def save_json_atomic(path: str, obj, *, indent: int | None = None) -> str:
+    """json.dump published by fsync'd write + atomic rename.
+
+    The JSON counterpart of :func:`save_npz_atomic` for experiment
+    reports and sealed envelopes: a kill mid-write never leaves a
+    truncated document at ``path``. This (or the artifacts layer) is
+    the sanctioned route for persisted JSON — raw ``json.dump`` /
+    ``open(.., "w")`` writes are flagged by lint rule FIA101.
+    """
+    return _write_atomic(
+        path, ".jsontmp.", ".json",
+        lambda f: json.dump(obj, f, indent=indent),
+    )
+
+
+def save_text_atomic(path: str, text: str) -> str:
+    """A text document published by fsync'd write + atomic rename."""
+    return _write_atomic(
+        path, ".txttmp.", ".txt", lambda f: f.write(text)
+    )
+
+
+def savetxt_atomic(path: str, array, **kwargs) -> str:
+    """np.savetxt published by fsync'd write + atomic rename (the TSV
+    dataset-fixture writer's durable form)."""
+    return _write_atomic(
+        path, ".txttmp.", ".txt",
+        lambda f: np.savetxt(f, array, **kwargs),
+    )
 
 
 def _file_sha256(path: str) -> str:

@@ -30,7 +30,6 @@ from __future__ import annotations
 import hashlib
 import json
 import os
-import tempfile
 
 _MAGIC = "fia-memlimits-v1"
 _SEAL = "__integrity__"
@@ -201,21 +200,8 @@ def update(
         sealed[_SEAL] = {
             "magic": _MAGIC, "checksum": _entries_checksum(data)
         }
-        fd, tmp = tempfile.mkstemp(dir=d, prefix=".mem_limits.")
-        try:
-            with os.fdopen(fd, "w") as f:
-                json.dump(sealed, f)
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, path)
-        except BaseException:
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
-            raise
-        from fia_tpu.utils.io import fsync_dir
+        from fia_tpu.utils.io import save_json_atomic
 
-        fsync_dir(d)
+        save_json_atomic(path, sealed)
     except OSError:
         pass  # best-effort: a lost update costs one re-learning failure

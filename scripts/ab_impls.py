@@ -34,6 +34,8 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from fia_tpu.utils.io import save_json_atomic  # noqa: E402
+
 # The axon (tunneled-TPU) image's sitecustomize re-selects its platform
 # via jax.config at interpreter start, OVERRIDING JAX_PLATFORMS — an
 # explicit CPU ask must be re-applied through jax.config too.
@@ -274,8 +276,7 @@ def main():
 
     print(json.dumps(out))
     if args.out:
-        with open(args.out, "w") as fh:
-            fh.write(json.dumps(out) + "\n")
+        save_json_atomic(args.out, out)
 
 
 if __name__ == "__main__":

@@ -19,6 +19,9 @@ import sys
 
 import numpy as np
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from fia_tpu.utils.io import save_json_atomic  # noqa: E402
 
 def per_point(path):
     d = np.load(path)
@@ -78,9 +81,7 @@ def main():
         "slope_range_high": [min(sh), max(sh)],
     }
     path = args.out or os.path.join("output", "budget_ladder.json")
-    with open(path + ".tmp", "w") as fh:
-        json.dump(out, fh, indent=1)
-    os.replace(path + ".tmp", path)
+    save_json_atomic(path, out, indent=1)
     print(f"wrote {path}", file=sys.stderr)
 
 

@@ -34,6 +34,8 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from fia_tpu.utils.io import save_json_atomic  # noqa: E402
+
 SCALES = {
     "movielens": dict(users=6_040, items=3_706, rows=975_460,
                       batch_files=("ml-1m-ex.valid.rating",
@@ -178,8 +180,7 @@ def main():
               f"tails {tails}", flush=True)
     name = ("output/cal_evidence.json" if args.rev == "cal2"
             else f"output/cal_evidence_{args.rev}.json")
-    with open(name, "w") as f:
-        json.dump(out, f, indent=2)
+    save_json_atomic(name, out, indent=2)
 
 
 if __name__ == "__main__":

@@ -27,6 +27,8 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from fia_tpu.utils.io import save_json_atomic  # noqa: E402
+
 
 def pearson(a: np.ndarray, b: np.ndarray) -> float:
     if len(a) < 2 or np.std(a) == 0 or np.std(b) == 0:
@@ -103,8 +105,7 @@ def main():
         print(f"{os.path.basename(f)}: pooled r = {pooled:.4f} "
               f"[{lo:.4f}, {hi:.4f}] over {len(a)} rows / "
               f"{len(per_point)} points")
-    with open(args.out, "w") as fh:
-        json.dump(result, fh, indent=2)
+    save_json_atomic(args.out, result, indent=2)
 
 
 if __name__ == "__main__":

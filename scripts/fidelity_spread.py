@@ -32,9 +32,13 @@ import argparse
 import glob
 import json
 import os
+import sys
 
 import numpy as np
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from fia_tpu.utils.io import save_json_atomic  # noqa: E402
 
 def point_diagnostics(actual, predicted, groups):
     """Per-point spread diagnostics + pooled-floor model check.
@@ -174,10 +178,7 @@ def main():
                   f"{nd['retrain_noise']:.3e} (+) prediction_error "
                   f"{nd['prediction_error']:.3e} "
                   f"[noise share {nd['noise_share']:.0%}]")
-    if os.path.dirname(args.out):
-        os.makedirs(os.path.dirname(args.out), exist_ok=True)
-    with open(args.out, "w") as fh:
-        json.dump(report, fh, indent=1)
+    save_json_atomic(args.out, report, indent=1)
     print(f"wrote {args.out}")
 
 

@@ -37,6 +37,9 @@ import sys
 
 import numpy as np
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from fia_tpu.utils.io import save_json_atomic  # noqa: E402
 
 def subsets_of_size(R, r, max_draws=20, seed=0):
     """Distinct repeat-index subsets of size r (all of them if few,
@@ -171,9 +174,7 @@ def main():
     paths = args.artifacts or sorted(
         glob.glob(os.path.join("output", "RQ1-*.npz")))
     results = [analyze(p, args.max_draws) for p in paths]
-    with open(args.out + ".tmp", "w") as fh:
-        json.dump(results, fh, indent=1)
-    os.replace(args.out + ".tmp", args.out)
+    save_json_atomic(args.out, results, indent=1)
     for res in results:
         if "skipped" in res:
             continue

@@ -42,6 +42,8 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from fia_tpu.utils.io import save_json_atomic  # noqa: E402
+
 if os.environ.get("JAX_PLATFORMS", "") == "cpu":
     import jax
 
@@ -104,9 +106,11 @@ def main():
         for _ in range(args.rounds)
     ]
 
+    # fialint: disable=FIA203 -- fixed benchmark operands baked on purpose: one compile per variant, constant capture is the measured condition
     def direct(idx):
         return jnp.sum(table[idx] * fold)
 
+    # fialint: disable=FIA203 -- fixed benchmark operands baked on purpose: one compile per variant, constant capture is the measured condition
     def packed_fn(idx):
         rowsel = packed[idx // PACK].reshape(-1, PACK, K)
         g = jnp.take_along_axis(
@@ -114,6 +118,7 @@ def main():
         )[:, 0, :]
         return jnp.sum(g * fold)
 
+    # fialint: disable=FIA203 -- fixed benchmark operands baked on purpose: one compile per variant, constant capture is the measured condition
     def onehot(idx):
         tb = table.astype(jnp.bfloat16)
         nchunk = S // args.chunk
@@ -138,6 +143,7 @@ def main():
         )
         return acc
 
+    # fialint: disable=FIA203 -- fixed benchmark operands baked on purpose: one compile per variant, constant capture is the measured condition
     def sorted_fn(idx):
         order = jnp.argsort(idx)
         g = table[idx[order]]
@@ -205,9 +211,7 @@ def main():
     res["agreement"] = {
         n: round(v, 3) for n, v in vals.items()
     }
-    os.makedirs(os.path.dirname(args.out), exist_ok=True)
-    with open(args.out, "w") as f:
-        json.dump(res, f, indent=2)
+    save_json_atomic(args.out, res, indent=2)
 
 
 if __name__ == "__main__":

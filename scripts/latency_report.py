@@ -16,6 +16,17 @@ import sys
 
 import numpy as np
 
+# What this report reads, per event — cross-checked against the
+# emitted schema (fia_tpu/serve/metrics.py SCHEMA) by lint rule
+# FIA401: a field renamed on the producer side fails `make lint`
+# instead of rendering an empty column here. Keep it a literal dict.
+CONSUMES = {
+    "serve.request": ("status", "reason", "tier",
+                      "queue_wait_ms", "solve_ms"),
+    "serve.batch": ("size", "solve_ms"),
+    "serve.rollup": ("cache",),
+}
+
 
 def pcts(vals):
     if not vals:

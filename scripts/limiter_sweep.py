@@ -43,6 +43,8 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from fia_tpu.utils.io import save_json_atomic  # noqa: E402
+
 if os.environ.get("JAX_PLATFORMS", "") == "cpu":
     import jax
 
@@ -245,10 +247,7 @@ def main():
         "implied_GBps_at_4KB_per_row": round(
             4096 / (ns_per_row * 1e-9) / 1e9, 1) if ns_per_row > 0 else None,
     }
-    os.makedirs(os.path.dirname(args.out), exist_ok=True)
-    with open(args.out + ".tmp", "w") as fh:
-        json.dump(out, fh, indent=1)
-    os.replace(args.out + ".tmp", args.out)
+    save_json_atomic(args.out, out, indent=1)
     log(f"wrote {args.out}")
     print(json.dumps(out["fit"]))
 

@@ -38,6 +38,8 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from fia_tpu.utils.io import save_json_atomic  # noqa: E402
+
 
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
@@ -128,11 +130,7 @@ def main():
                                f"{len(points)} of bench.py's 256",
         },
     }
-    os.makedirs(os.path.dirname(args.out), exist_ok=True)
-    tmp = args.out + ".tmp"
-    with open(tmp, "w") as fh:
-        json.dump(out, fh, indent=1)
-    os.replace(tmp, args.out)
+    save_json_atomic(args.out, out, indent=1)
     print(json.dumps({"scores_per_sec": out["mf"]["scores_per_sec"],
                       "queries": len(points),
                       "loadavg": load_before}))

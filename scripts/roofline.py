@@ -40,6 +40,8 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from fia_tpu.utils.io import save_json_atomic  # noqa: E402
+
 # The axon (tunneled-TPU) image's sitecustomize re-selects its platform
 # via jax.config at interpreter start, OVERRIDING JAX_PLATFORMS — an
 # explicit CPU ask must be re-applied through jax.config too.
@@ -312,9 +314,7 @@ def main():
 
     print(json.dumps(result, indent=2))
     if args.out:
-        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
-        with open(args.out, "w") as f:
-            json.dump(result, f, indent=2)
+        save_json_atomic(args.out, result, indent=2)
 
 
 if __name__ == "__main__":

@@ -9,9 +9,16 @@
 set -o pipefail
 cd "$(dirname "$0")/.."
 rm -f /tmp/_t1.log
-# Serving smoke first, NON-fatal (the `|| true`): the pinned tier-1
-# verdict below stays exactly the ROADMAP.md pytest command, the smoke
-# just surfaces serving regressions in the same log.
+# Lint first, FATAL: a raw write, trace-hygiene hazard, unregistered
+# injection site, or metrics-schema drift fails tier-1 before pytest
+# runs. docs/lint.md has the rule catalog.
+python -m fia_tpu.analysis.lint fia_tpu scripts bench.py || {
+  echo "fialint FAILED (see findings above; docs/lint.md for the rules)"
+  exit 1
+}
+# Serving smoke next, NON-fatal: the pinned tier-1 verdict below stays
+# exactly the ROADMAP.md pytest command, the smoke just surfaces
+# serving regressions in the same log.
 bash scripts/serve_smoke.sh || echo "serve-smoke FAILED (non-fatal here; run make serve-smoke)"
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
   -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \

@@ -1,0 +1,510 @@
+"""The AST lint engine (fia_tpu/analysis): rule detection fixtures,
+suppression semantics, reporters, and the self-check-clean invariant.
+
+Each rule family gets a good/bad fixture pair: the bad fixture proves
+the rule *detects* its violation class (the live repo is clean, so
+without fixtures a silently-broken rule would look like a passing
+gate), the good fixture proves the idiomatic form doesn't false-
+positive. Fixtures are written into tmp mini-repos (pyproject.toml
+marks the root) so the cross-file ProjectRules resolve their
+registries relative to the fixture, not this repo.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+from fia_tpu.analysis.core import lint_paths
+from fia_tpu.analysis.lint import self_check_paths
+from fia_tpu.analysis.reporters import json_report, terminal_report
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _mini_repo(tmp_path, files: dict[str, str]):
+    """Write a fixture tree under tmp_path with a pyproject.toml root."""
+    (tmp_path / "pyproject.toml").write_text("[project]\nname='x'\n")
+    paths = []
+    for rel, body in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(body))
+        paths.append(str(p))
+    return paths
+
+
+def _lint(tmp_path, files, **kw):
+    paths = _mini_repo(tmp_path, files)
+    return lint_paths(paths, root=str(tmp_path), **kw)
+
+
+def _rules_hit(result):
+    return {f.rule for f in result.findings}
+
+
+class TestRawWrite:
+    def test_bad_raw_writes_flagged(self, tmp_path):
+        res = _lint(tmp_path, {"scripts/report.py": """\
+            import json
+            import numpy as np
+            from pathlib import Path
+
+            def dump(path, obj, arr):
+                with open(path, "w") as fh:
+                    json.dump(obj, fh)
+                np.save(path, arr)
+                np.savetxt(path, arr)
+                Path(path).write_text("x")
+        """})
+        lines = sorted(f.line for f in res.findings)
+        assert _rules_hit(res) == {"FIA101"}
+        assert len(res.findings) == 5  # open, json.dump, save, savetxt, write_text
+
+    def test_good_forms_clean(self, tmp_path):
+        res = _lint(tmp_path, {"scripts/report.py": """\
+            from fia_tpu.utils.io import save_json_atomic
+
+            def dump(path, obj, log_path):
+                save_json_atomic(path, obj)
+                with open(path) as fh:        # read is fine
+                    fh.read()
+                with open(log_path, "a") as fh:  # append-only journal idiom
+                    fh.write("line")
+        """})
+        assert res.ok, [f.render() for f in res.findings]
+
+    def test_allowlisted_module_exempt(self, tmp_path):
+        res = _lint(tmp_path, {"fia_tpu/utils/io.py": """\
+            import json
+
+            def save(path, obj):
+                with open(path, "w") as fh:
+                    json.dump(obj, fh)
+        """})
+        assert res.ok, [f.render() for f in res.findings]
+
+
+class TestTraceHygiene:
+    def test_bad_host_sync_and_branch(self, tmp_path):
+        res = _lint(tmp_path, {"fia_tpu/kernels.py": """\
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+
+            @jax.jit
+            def f(x):
+                y = jnp.sum(x)
+                if y > 0:
+                    print("positive")
+                v = float(y)
+                z = np.asarray(y)
+                return y.item()
+        """})
+        assert "FIA201" in _rules_hit(res)
+        assert "FIA202" in _rules_hit(res)
+        msgs = " ".join(f.message for f in res.findings)
+        assert "print()" in msgs and ".item()" in msgs and "float()" in msgs
+
+    def test_bad_jit_call_form_detected(self, tmp_path):
+        # jit applied at the call site, not as a decorator
+        res = _lint(tmp_path, {"fia_tpu/kernels.py": """\
+            import jax
+            import jax.numpy as jnp
+
+            def solve(x):
+                if x.sum() > 0:
+                    return jnp.zeros(())
+                return jnp.ones(())
+
+            solve_fast = jax.jit(solve)
+        """})
+        assert "FIA202" in _rules_hit(res)
+
+    def test_good_static_branch_clean(self, tmp_path):
+        res = _lint(tmp_path, {"fia_tpu/kernels.py": """\
+            from functools import partial
+
+            import jax
+            import jax.numpy as jnp
+
+            @partial(jax.jit, static_argnums=(1,))
+            def f(x, mode, mask=None):
+                if mode == "fast":        # static arg: fine
+                    x = x * 2
+                if mask is not None:      # None-check idiom: fine
+                    x = x * mask
+                return jnp.where(x > 0, x, 0.0)
+        """})
+        assert res.ok, [f.render() for f in res.findings]
+
+    def test_bad_closure_capture(self, tmp_path):
+        res = _lint(tmp_path, {"fia_tpu/kernels.py": """\
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+
+            def build(n):
+                table = np.zeros((n, 4), np.float32)
+
+                @jax.jit
+                def gather(idx):
+                    return jnp.sum(table[idx])
+
+                return gather
+        """})
+        assert _rules_hit(res) == {"FIA203"}
+        (f,) = res.findings
+        assert "table" in f.message
+
+    def test_good_capture_as_argument_clean(self, tmp_path):
+        res = _lint(tmp_path, {"fia_tpu/kernels.py": """\
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+
+            def build(n):
+                table = np.zeros((n, 4), np.float32)
+
+                @jax.jit
+                def gather(table, idx):
+                    return jnp.sum(table[idx])
+
+                return lambda idx: gather(table, idx)
+        """})
+        assert res.ok, [f.render() for f in res.findings]
+
+
+_SITES_FIXTURE = """\
+    GOOD = "engine.solve"
+    ALL_SITES = frozenset({GOOD})
+"""
+
+
+class TestSiteIntegrity:
+    def test_bad_unregistered_literal(self, tmp_path):
+        res = _lint(tmp_path, {
+            "fia_tpu/reliability/sites.py": _SITES_FIXTURE,
+            "fia_tpu/engine.py": """\
+                from fia_tpu.reliability import inject
+
+                def solve():
+                    inject.fire("engine.solve")
+                    inject.fire("engine.sovle")  # typo'd site
+            """,
+        }, select={"FIA301"})
+        assert _rules_hit(res) == {"FIA301"}
+        (f,) = res.findings
+        assert "engine.sovle" in f.message
+
+    def test_bad_unknown_constant(self, tmp_path):
+        res = _lint(tmp_path, {
+            "fia_tpu/reliability/sites.py": _SITES_FIXTURE,
+            "fia_tpu/engine.py": """\
+                from fia_tpu.reliability import inject, sites
+
+                def solve():
+                    inject.fire(sites.ENGINE_SOVLE)
+            """,
+        }, select={"FIA301"})
+        assert _rules_hit(res) == {"FIA301"}
+
+    def test_good_registered_forms_clean(self, tmp_path):
+        res = _lint(tmp_path, {
+            "fia_tpu/reliability/sites.py": _SITES_FIXTURE,
+            "fia_tpu/engine.py": """\
+                from fia_tpu.reliability import inject, sites
+
+                def solve(site_var):
+                    inject.fire("engine.solve")
+                    inject.fire(sites.GOOD)
+                    inject.fire(site_var)  # dynamic: sites.check()'s job
+            """,
+        }, select={"FIA301"})
+        assert res.ok, [f.render() for f in res.findings]
+
+    def test_no_registry_demanded_without_site_usage(self, tmp_path):
+        # a tree with no fault injection shouldn't be told to create one
+        res = _lint(tmp_path, {"pkg/mod.py": "x = 1\n"})
+        assert res.ok, [f.render() for f in res.findings]
+
+    def test_bad_reliability_raise(self, tmp_path):
+        res = _lint(tmp_path, {"fia_tpu/reliability/retry.py": """\
+            def attempt():
+                raise RuntimeError("unclassifiable")
+        """})
+        assert _rules_hit(res) == {"FIA302"}
+
+    def test_good_reliability_raises_clean(self, tmp_path):
+        res = _lint(tmp_path, {"fia_tpu/reliability/retry.py": """\
+            from fia_tpu.reliability import taxonomy
+
+            def attempt(budget):
+                if budget < 0:
+                    raise ValueError("negative budget")
+                try:
+                    work()
+                except Exception:
+                    raise  # bare re-raise: fine
+                raise taxonomy.DeadlineExpired("out of budget")
+        """})
+        assert res.ok, [f.render() for f in res.findings]
+
+    def test_bad_docs_drift_both_directions(self, tmp_path):
+        res = _lint(tmp_path, {
+            "fia_tpu/reliability/sites.py": """\
+                A = "engine.solve"
+                B = "engine.upload"
+                ALL_SITES = frozenset({A, B})
+            """,
+            "docs/reliability.md": """\
+                # Reliability
+
+                ## Injection-site registry
+
+                | site | where |
+                | --- | --- |
+                | `engine.solve` | the solve |
+                | `engine.stale_row` | removed last PR |
+            """,
+            # the rules need at least one .py lint target
+            "fia_tpu/engine.py": "x = 1\n",
+        })
+        msgs = [f.message for f in res.findings]
+        assert _rules_hit(res) == {"FIA303"}
+        assert any("engine.upload" in m and "missing" in m for m in msgs)
+        assert any("engine.stale_row" in m and "stale" in m for m in msgs)
+
+
+_METRICS_FIXTURE = """\
+    SCHEMA = {
+        "serve.request": ("id", "status", "solve_ms"),
+    }
+
+    class EventLog:
+        def log(self, event, **fields):
+            pass
+"""
+
+
+class TestMetricsSchema:
+    def test_bad_undeclared_event_and_field(self, tmp_path):
+        res = _lint(tmp_path, {
+            "fia_tpu/serve/metrics.py": _METRICS_FIXTURE,
+            "fia_tpu/serve/service.py": """\
+                def handle(log):
+                    log.log("serve.request", id=1, status="ok",
+                            latency_ms=3.0)   # renamed field
+                    log.log("serve.requets", id=2)  # typo'd event
+            """,
+        })
+        msgs = " ".join(f.message for f in res.findings)
+        assert _rules_hit(res) == {"FIA401"}
+        assert "latency_ms" in msgs and "serve.requets" in msgs
+
+    def test_bad_consumer_drift(self, tmp_path):
+        res = _lint(tmp_path, {
+            "fia_tpu/serve/metrics.py": _METRICS_FIXTURE,
+            "fia_tpu/serve/service.py": """\
+                def handle(log):
+                    log.log("serve.request", id=1, status="ok")
+            """,
+            "scripts/latency_report.py": """\
+                CONSUMES = {
+                    "serve.request": ("status", "queue_wait_ms"),
+                    "serve.batch": ("size",),
+                }
+            """,
+        })
+        msgs = " ".join(f.message for f in res.findings)
+        assert _rules_hit(res) == {"FIA401"}
+        assert "queue_wait_ms" in msgs and "serve.batch" in msgs
+
+    def test_good_schema_agreement_clean(self, tmp_path):
+        res = _lint(tmp_path, {
+            "fia_tpu/serve/metrics.py": _METRICS_FIXTURE,
+            "fia_tpu/serve/service.py": """\
+                def handle(log):
+                    log.log("serve.request", id=1, status="ok",
+                            solve_ms=2.5)
+            """,
+            "scripts/latency_report.py": """\
+                CONSUMES = {"serve.request": ("status", "solve_ms")}
+            """,
+        })
+        assert res.ok, [f.render() for f in res.findings]
+
+
+_BAD_WRITE = """\
+    import json
+
+    def dump(path, obj):{maybe_comment}
+        with open(path, "w") as fh:{inline}
+            json.dump(obj, fh)
+"""
+
+
+class TestSuppressions:
+    def _src(self, inline="", maybe_comment=""):
+        return {"scripts/r.py": _BAD_WRITE.format(
+            inline=inline, maybe_comment=maybe_comment
+        )}
+
+    def test_justified_inline_suppression(self, tmp_path):
+        res = _lint(tmp_path, self._src(
+            inline="  # fialint: disable=FIA101 -- fixture wants raw bytes"
+        ))
+        assert [f.rule for f in res.findings] == ["FIA101"]  # json.dump line
+        assert any(s.rule == "FIA101" for s in res.suppressed)
+
+    def test_justified_standalone_shields_next_line(self, tmp_path):
+        res = _lint(tmp_path, self._src(
+            maybe_comment="\n        "
+            "# fialint: disable=FIA101 -- fixture wants raw bytes"
+        ))
+        assert sum(f.rule == "FIA101" for f in res.findings) == 1
+
+    def test_unjustified_suppression_is_a_finding(self, tmp_path):
+        res = _lint(tmp_path, self._src(
+            inline="  # fialint: disable=FIA101"
+        ))
+        rules = [f.rule for f in res.findings]
+        assert "FIA001" in rules  # the bad suppression itself
+        assert "FIA101" in rules  # and it does NOT suppress
+
+    def test_unknown_rule_id_is_a_finding(self, tmp_path):
+        res = _lint(tmp_path, self._src(
+            inline="  # fialint: disable=FIA999 -- whatever"
+        ))
+        assert "FIA001" in {f.rule for f in res.findings}
+
+    def test_docstring_mention_is_not_a_suppression(self, tmp_path):
+        res = _lint(tmp_path, {"scripts/r.py": '''\
+            """Docs may say '# fialint: disable=FIA101' without effect."""
+
+            x = 1
+        '''})
+        assert res.ok, [f.render() for f in res.findings]
+
+    def test_select_and_disable(self, tmp_path):
+        files = {"fia_tpu/reliability/retry.py": """\
+            import json
+
+            def attempt(path):
+                with open(path, "w") as fh:
+                    json.dump({}, fh)
+                raise RuntimeError("boom")
+        """}
+        both = _lint(tmp_path, files)
+        assert _rules_hit(both) == {"FIA101", "FIA302"}
+        only_io = _lint(tmp_path, files, select={"FIA101"})
+        assert _rules_hit(only_io) == {"FIA101"}
+        no_io = _lint(tmp_path, files, disable={"FIA101"})
+        assert _rules_hit(no_io) == {"FIA302"}
+
+
+class TestReporters:
+    def test_json_report_golden(self, tmp_path):
+        res = _lint(tmp_path, {"scripts/r.py": """\
+            import json
+
+            def dump(path, obj):
+                with open(path, "w") as fh:
+                    json.dump(obj, fh)
+        """})
+        doc = json.loads(json_report(res))
+        assert doc["version"] == 1
+        assert doc["ok"] is False
+        assert doc["files_checked"] == 1
+        assert doc["counts"] == {"FIA101": 2}
+        first = doc["findings"][0]
+        assert set(first) == {"rule", "path", "line", "col", "message"}
+        assert first["path"] == "scripts/r.py"
+        # deterministic: same input, byte-identical report
+        res2 = lint_paths(
+            [str(tmp_path / "scripts" / "r.py")], root=str(tmp_path)
+        )
+        assert json_report(res2) == json_report(res)
+
+    def test_terminal_report_lines(self, tmp_path):
+        res = _lint(tmp_path, {"scripts/r.py": """\
+            import numpy as np
+
+            def dump(path, arr):
+                np.save(path, arr)
+        """})
+        out = terminal_report(res)
+        assert "scripts/r.py:4:" in out
+        assert "FIA101" in out
+        assert "1 finding(s)" in out
+
+
+class TestSelfCheck:
+    def test_repo_is_clean(self):
+        """The acceptance invariant: the repo lints clean, and every
+        suppression that exists carries a justification (unjustified
+        ones surface as FIA001 findings and fail this)."""
+        paths, root = self_check_paths()
+        res = lint_paths(paths, root=root)
+        assert res.ok, "\n".join(f.render() for f in res.findings)
+        assert res.files_checked > 50
+
+    def test_cli_self_check_exit_zero(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "fia_tpu.analysis.lint", "--self-check"],
+            cwd=REPO, capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "OK" in proc.stdout
+
+    def test_cli_exit_codes(self, tmp_path):
+        bad = tmp_path / "b.py"
+        bad.write_text("import json\n\n"
+                       "def d(p, o):\n"
+                       "    with open(p, 'w') as fh:\n"
+                       "        json.dump(o, fh)\n")
+        (tmp_path / "pyproject.toml").write_text("[project]\nname='x'\n")
+        proc = subprocess.run(
+            [sys.executable, "-m", "fia_tpu.analysis.lint", str(bad)],
+            cwd=REPO, capture_output=True, text=True, timeout=60,
+        )
+        assert proc.returncode == 1
+        proc = subprocess.run(
+            [sys.executable, "-m", "fia_tpu.analysis.lint",
+             str(tmp_path / "nope.py")],
+            cwd=REPO, capture_output=True, text=True, timeout=60,
+        )
+        assert proc.returncode == 2
+
+
+class TestSiteRegistryDocSync:
+    def test_registry_sites_all_documented(self):
+        """Every registered production site appears in the
+        docs/reliability.md table, and the table has no stale rows —
+        the live-repo form of the FIA303 fixture above."""
+        from fia_tpu.reliability import sites
+
+        doc = open(os.path.join(REPO, "docs", "reliability.md")).read()
+        assert "## Injection-site registry" in doc
+        for site in sites.ALL_SITES:
+            assert f"`{site}`" in doc, f"{site} missing from docs"
+
+    def test_registry_check(self):
+        import pytest
+
+        from fia_tpu.reliability import sites
+
+        sites.check(sites.ENGINE_SOLVE)
+        with pytest.raises(ValueError, match="unknown injection site"):
+            sites.check("engine.sovle")
+
+    def test_production_fire_sites_are_registered(self):
+        """AST-level: the lint rule's own view of the live repo — every
+        site literal/constant in fia_tpu/ resolves to the registry."""
+        res = lint_paths(
+            [os.path.join(REPO, "fia_tpu")], select={"FIA301"}, root=REPO
+        )
+        assert res.ok, "\n".join(f.render() for f in res.findings)
