@@ -1,7 +1,7 @@
 # Convenience targets. The commands themselves are pinned in
 # ROADMAP.md (tier-1) and scripts/ — these targets just name them.
 
-.PHONY: tier1 test lint lint-io serve-smoke
+.PHONY: tier1 test lint lint-io serve-smoke chaos-smoke chaos-soak
 
 # The ROADMAP.md tier-1 verify: fast CPU suite, slow tests excluded.
 # Lint is fatal — a finding fails the build before pytest runs.
@@ -28,3 +28,18 @@ lint-io:
 # on CPU (<60s) — zero unreasoned drops, hot-cache hits, latency report.
 serve-smoke:
 	bash scripts/serve_smoke.sh
+
+# Chaos smoke: fixed-seed benign fault schedules against the three
+# end-to-end scenarios (train→kill→resume, cached query_many, serve
+# stream) on CPU (<60s) — bit-identity vs golden runs, classified
+# errors only, armed⇒fired fault accounting. docs/reliability.md has
+# the schedule format and oracle catalog.
+chaos-smoke:
+	bash scripts/chaos_smoke.sh
+
+# Chaos soak: a seed-range sweep over the FULL fault domain (kill
+# kinds, NaN payloads, deadlines) — the fuzz mode; not part of tier-1.
+# Failures shrink to minimal repro JSONs replayable with
+#   python -m fia_tpu.cli.chaos --replay <repro.json>
+chaos-soak:
+	JAX_PLATFORMS=cpu python -m fia_tpu.cli.chaos --soak 0:25 --all_kinds

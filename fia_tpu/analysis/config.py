@@ -38,6 +38,7 @@ RELIABILITY_RAISABLE = frozenset({
     "NanPayload",
     "ArtifactIntegrityError",
     "JournalMismatch",
+    "UnfiredFaultError",
     "ValueError",
     "TypeError",
     "KeyError",

@@ -1,0 +1,37 @@
+"""Chaos scenario engine: seeded fault schedules, end-to-end invariant
+oracles, and automatic schedule shrinking.
+
+Four PRs of reliability machinery (taxonomy/retry ladders, artifact
+integrity, serving admission control, the lint-enforced site registry)
+each carry a bit-identity or determinism contract that unit tests
+exercise one injected fault at a time. Influence pipelines fail in
+*composed* ways — solver state × checkpoint state × batching
+("Scaling Up Influence Functions", arXiv:2112.03052) — so this package
+turns those isolated harnesses into one continuously-exercised soak
+layer:
+
+- :mod:`~fia_tpu.chaos.schedule` — seeded, replayable fault schedules
+  drawn from the checked-in site registry (multi-fault and
+  repeated-fault compositions, JSON round-trip for repro files);
+- :mod:`~fia_tpu.chaos.scenarios` — real end-to-end workloads
+  (train→checkpoint→kill→resume, journaled ``query_many`` over a
+  damaged disk tier, a serve stream under dispatch faults + overload)
+  driven through the production Trainer/engine/service entry points
+  under virtual time;
+- :mod:`~fia_tpu.chaos.oracles` — invariants checked after every run:
+  bit-identical results vs. an undisturbed golden run, classified
+  errors only, armed ⇒ fired-or-reported fault accounting, on-disk
+  artifact detectability;
+- :mod:`~fia_tpu.chaos.shrink` — delta debugging (ddmin) reduces a
+  failing schedule to a minimal reproducing fault sequence;
+- :mod:`~fia_tpu.chaos.runner` — the engine tying them together and
+  emitting replayable repro JSON
+  (``python -m fia_tpu.cli.chaos --replay repro.json``).
+
+Entry points: ``make chaos-smoke`` (fixed seed, CPU-bounded, fatal in
+tier-1), ``make chaos-soak`` (seed-range sweep, not in tier-1), and
+``python -m fia_tpu.cli.chaos`` for everything else.
+"""
+
+from fia_tpu.chaos.runner import ChaosEngine, ChaosReport  # noqa: F401
+from fia_tpu.chaos.schedule import ChaosFault, Schedule, generate  # noqa: F401

@@ -1,0 +1,497 @@
+"""End-to-end chaos scenarios over the production entry points.
+
+Each scenario is a small, CPU-bounded workload driven through the REAL
+Trainer / InfluenceEngine / InfluenceService code paths — not mocks —
+with a declared *fault domain*: the injection sites the workload is
+guaranteed to reach, the kinds meaningful there, and how many calls
+each site is guaranteed to see (``max_at``). The benign domain is the
+subset whose documented recovery is bit-identity-preserving; schedules
+drawn from it must reproduce the undisturbed golden run exactly.
+
+Scenario state that is safe to share across runs (compiled epoch fns,
+engine jit caches) lives on the scenario instance so a smoke run pays
+each XLA compile once; everything run-scoped (checkpoints, journals,
+disk caches) lands in the per-run ``workdir``. All retry backoff runs
+under a :class:`~fia_tpu.reliability.policy.VirtualClock` — a chaos
+run never sleeps wall-clock time.
+
+The two ``selftest`` scenarios are the harness's own fixtures: a
+trivial retry-loop workload with a deliberately *broken* variant whose
+retry path drops a unit's contribution. The broken one exists so tests
+(and ``--scenario selftest-broken``) can watch the full
+fail → shrink → replay pipeline end-to-end without touching jax.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from fia_tpu.reliability import inject, sites, taxonomy
+from fia_tpu.reliability import policy as rpolicy
+from fia_tpu.reliability.journal import Journal
+
+# Transient device kinds whose retry recovery is bit-identical
+# (functional inputs reused verbatim).
+_TRANSIENT_KINDS = (taxonomy.WORKER, taxonomy.PREEMPTION, taxonomy.AMBIGUOUS)
+# On-disk damage kinds; recovery is walk-back / self-heal — bit-identical.
+_DAMAGE_KINDS = (inject.TORN, inject.BITFLIP, inject.STALE_MANIFEST)
+# Kinds that kill a workload (classified surfacing, no bit-identity).
+_KILL_KINDS = (taxonomy.OOM, taxonomy.HOST_OOM, taxonomy.DEADLINE)
+
+# Shared tiny-MF workload shape (the repo's test convention).
+_U, _I, _K = 30, 20, 4
+_WD, _DAMP = 1e-2, 1e-3
+
+# Backoff shaped like production training retry but able to absorb a
+# worst-case smoke schedule (3 consecutive transient faults on one
+# site); the VirtualClock makes the delays free.
+_CHAOS_RETRY = rpolicy.RetryPolicy(
+    max_attempts=4, base_delay=2.0, max_delay=30.0, jitter=0.25
+)
+
+
+def _toy_data(seed: int, n: int):
+    rng = np.random.default_rng(seed)
+    x = np.stack(
+        [rng.integers(0, _U, n), rng.integers(0, _I, n)], axis=1
+    ).astype(np.int32)
+    y = rng.integers(1, 6, n).astype(np.float32)
+    return x, y
+
+
+class Scenario:
+    """Base: a named workload with benign/full fault domains.
+
+    ``run(workdir, events)`` executes the workload under whatever fault
+    plan the runner armed and returns the outcome payload (name →
+    array/str/int). It raises on unrecovered failure — classification
+    is the runner's job.
+    """
+
+    name: str = "?"
+    benign_domain: dict = {}
+    full_domain: dict = {}
+
+    def domain(self, benign: bool) -> dict:
+        return self.benign_domain if benign else self.full_domain
+
+    def run(self, workdir: str, events: list) -> dict:
+        raise NotImplementedError
+
+    def check(self, golden: dict, record) -> list:
+        """Scenario-specific oracles beyond the standard battery."""
+        return []
+
+
+class SelftestScenario(Scenario):
+    """Retry-loop counter workload — the harness validating itself.
+
+    Six work units, each firing ``chaos.unit`` inside a production
+    RetryPolicy under virtual time. Transient kinds retry to the same
+    unit value (bit-identical); kill kinds surface classified.
+    """
+
+    name = "selftest"
+    UNITS = 6
+    broken = False
+    benign_domain = {
+        sites.CHAOS_UNIT: (_TRANSIENT_KINDS, UNITS),
+    }
+    full_domain = {
+        sites.CHAOS_UNIT: (_TRANSIENT_KINDS + _KILL_KINDS, UNITS),
+        sites.CHAOS_SCENARIO: ((taxonomy.WORKER,), 1),
+    }
+
+    def run(self, workdir: str, events: list) -> dict:
+        clock = rpolicy.VirtualClock()
+        vals = []
+        for u in range(self.UNITS):
+            retried = []
+
+            def work(u=u):
+                inject.fire(sites.CHAOS_UNIT)
+                return float(u * 3 + 1)
+
+            v = _CHAOS_RETRY.run(
+                work, clock=clock,
+                on_retry=lambda kind, a, e: retried.append(kind),
+            )
+            if retried:
+                events.append({"event": "unit_retried", "unit": u,
+                               "kinds": list(retried)})
+            if self.broken and retried:
+                # The deliberately seeded bug (selftest-broken only): a
+                # retried unit loses its contribution. The bit_identity
+                # oracle must catch this and ddmin must shrink any
+                # schedule that trips it to a single transient fault.
+                v = 0.0
+            vals.append(v)
+        return {"units": np.asarray(vals, np.float64)}
+
+
+class SelftestBrokenScenario(SelftestScenario):
+    name = "selftest-broken"
+    broken = True
+
+
+class TrainResumeScenario(Scenario):
+    """train → checkpoint → kill → restore → resume, bit-identically.
+
+    Phase 1 trains to the kill step under rotated checkpointing, then
+    the in-memory state is discarded (the kill). Phase 2 sweeps stale
+    temps, restores the newest valid generation — walking back past
+    damaged ones, all the way to from-scratch when every generation is
+    corrupt — and finishes training. The absolute-step epoch keys and
+    step masks make the final params bit-identical to an uninterrupted
+    golden run from ANY valid restore point, which is exactly what the
+    oracle asserts.
+    """
+
+    name = "train_resume"
+    N, BATCH, STEPS, KILL, EVERY, KEEP = 400, 100, 40, 24, 8, 3
+    # phase 1: 6 epoch dispatches; phase 2: >= 4 more (restore at the
+    # kill step) — 10 guaranteed. Checkpoint publishes: 3 in phase 1,
+    # >= 2 in phase 2.
+    benign_domain = {
+        sites.TRAINER_EPOCH: (_TRANSIENT_KINDS, 10),
+        sites.CHECKPOINT_PUBLISH: (_DAMAGE_KINDS, 5),
+    }
+    full_domain = {
+        sites.TRAINER_EPOCH: (_TRANSIENT_KINDS + (taxonomy.OOM,), 10),
+        sites.CHECKPOINT_PUBLISH: (_DAMAGE_KINDS, 5),
+        sites.CHAOS_SCENARIO: ((taxonomy.WORKER,), 1),
+    }
+
+    def __init__(self):
+        from fia_tpu.models import MF
+        from fia_tpu.train.trainer import TrainConfig, Trainer
+
+        import jax
+
+        self.x, self.y = _toy_data(0, self.N)
+        self.model = MF(_U, _I, _K, _WD)
+        self.params0 = self.model.init_params(jax.random.PRNGKey(0))
+        cfg = TrainConfig(batch_size=self.BATCH, num_steps=self.STEPS,
+                          learning_rate=1e-2, seed=0)
+        # one Trainer for every run/phase: the compiled epoch fn is
+        # shared, and the VirtualClock absorbs retry backoff
+        self.trainer = Trainer(self.model, cfg, retry_policy=_CHAOS_RETRY,
+                               clock=rpolicy.VirtualClock())
+        self.fingerprint = {"kind": "chaos-train", "seed": 0,
+                            "steps": self.STEPS, "batch": self.BATCH}
+
+    def _params_outcome(self, state) -> dict:
+        import jax
+
+        out = {"step": int(state.step)}
+        for i, leaf in enumerate(jax.tree_util.tree_leaves(state.params)):
+            out[f"param{i}"] = np.asarray(leaf)
+        return out
+
+    def run(self, workdir: str, events: list) -> dict:
+        from fia_tpu.train import checkpoint
+        from fia_tpu.train.trainer import TrainState
+        from fia_tpu.utils import io
+
+        ckpt_dir = os.path.join(workdir, "ckpts")
+        ck1 = checkpoint.PeriodicCheckpointer(
+            ckpt_dir, every=self.EVERY, keep=self.KEEP,
+            fingerprint=self.fingerprint)
+        state = self.trainer.init_state(self.params0)
+        # phase 1: train to the kill point, then discard state (the kill)
+        self.trainer.fit(state, self.x, self.y, num_steps=self.KILL,
+                         checkpointer=ck1)
+
+        # phase 2: a fresh process would sweep temps, restore, resume
+        io.sweep_stale_tmps(ckpt_dir)
+        restored = checkpoint.restore_latest_valid(
+            ckpt_dir, self.params0, self.trainer.init_state(self.params0).opt_state,
+            fingerprint=self.fingerprint, verbose=False)
+        if restored is None:
+            # every generation corrupt: the ladder's last rung
+            events.append({"event": "restore_exhausted",
+                           "kind": "from_scratch"})
+            state2 = self.trainer.init_state(self.params0)
+        else:
+            events.append({"event": "resumed", "step": int(restored[2])})
+            state2 = TrainState(restored[0], restored[1], restored[2])
+        ck2 = checkpoint.PeriodicCheckpointer(
+            ckpt_dir, every=self.EVERY, keep=self.KEEP,
+            fingerprint=self.fingerprint)
+        ck2._last_step = state2.step
+        final = self.trainer.fit(
+            state2, self.x, self.y,
+            num_steps=self.STEPS - int(state2.step), checkpointer=ck2)
+        return self._params_outcome(final)
+
+
+class QueryCacheScenario(Scenario):
+    """Journaled ``query_many`` plus the verified iHVP disk cache.
+
+    Part A runs a journaled multi-batch ``query_many`` with a resume
+    loop: an injected ``deadline`` surfaces cleanly with completed
+    batches banked, and the reopened journal finishes the remainder —
+    the combined scores must be bit-identical to one undisturbed run.
+    Part B exercises the disk-cache tier twice per point so a damaged
+    entry is quarantined and self-heals into a clean recompute.
+    """
+
+    name = "query_cache"
+    NPTS, BQ = 6, 2
+    benign_domain = {
+        # 3 guaranteed pipelined dispatches (part A)
+        sites.ENGINE_DISPATCH_FLAT: (
+            _TRANSIENT_KINDS[:2] + (taxonomy.DEADLINE,), 3),
+        # 2 guaranteed first-publish cache entries (part B)
+        sites.ENGINE_CACHE_PUBLISH: (_DAMAGE_KINDS, 2),
+    }
+    full_domain = {
+        sites.ENGINE_DISPATCH_FLAT: (
+            _TRANSIENT_KINDS + (taxonomy.OOM, taxonomy.DEADLINE), 3),
+        sites.ENGINE_CACHE_PUBLISH: (_DAMAGE_KINDS, 2),
+        sites.ENGINE_SOLVE: ((taxonomy.NAN,), 1),
+        sites.CHAOS_SCENARIO: ((taxonomy.WORKER,), 1),
+    }
+
+    def __init__(self):
+        from fia_tpu.data.dataset import RatingDataset
+        from fia_tpu.influence.engine import InfluenceEngine
+        from fia_tpu.models import MF
+
+        import jax
+
+        x, y = _toy_data(0, 400)
+        self.train = RatingDataset(x, y)
+        self.model = MF(_U, _I, _K, _WD)
+        params = self.model.init_params(jax.random.PRNGKey(0))
+        rng = np.random.default_rng(1)
+        self.pts = np.stack(
+            [rng.integers(0, _U, self.NPTS), rng.integers(0, _I, self.NPTS)],
+            axis=1).astype(np.int32)
+        self.test_ds = RatingDataset(
+            self.pts[:2].copy(), np.full(2, 4.0, np.float32))
+        # one engine for every run (jit caches shared); the disk cache
+        # tier is re-pointed into each run's workdir
+        self.engine = InfluenceEngine(
+            self.model, params, self.train, damping=_DAMP,
+            model_name="chaos-mf")
+
+    def run(self, workdir: str, events: list) -> dict:
+        eng = self.engine
+        eng.cache_dir = os.path.join(workdir, "cache")
+        jpath = os.path.join(workdir, "journal.jsonl")
+        fp = eng.journal_fingerprint(self.pts, batch_queries=self.BQ)
+
+        # part A: journaled query_many with a deadline-resume loop
+        results = None
+        for attempt in range(len(self.pts) + 2):
+            j = Journal.open(jpath, fp, resume=os.path.exists(jpath),
+                             fsync=False)
+            try:
+                results = eng.query_many(self.pts, batch_queries=self.BQ,
+                                         journal=j)
+                break
+            except taxonomy.DeadlineExpired:
+                events.append({"event": "deadline_resume",
+                               "attempt": attempt})
+            finally:
+                j.close()
+        if results is None:
+            raise taxonomy.DeadlineExpired(
+                "query_many never completed within the resume budget")
+
+        out: dict = {}
+        t = 0
+        for r in results:
+            for row in range(len(np.asarray(r.counts))):
+                out[f"scores{t}"] = np.asarray(r.scores_of(row)).copy()
+                t += 1
+        out["points_done"] = t
+
+        # part B: publish two cache entries, then re-read them — a
+        # damaged entry must quarantine and self-heal to the same scores
+        for k in range(2):
+            first = eng.get_influence_on_test_loss([k], self.test_ds)
+            healed = eng.get_influence_on_test_loss(
+                [k], self.test_ds, force_refresh=False)
+            out[f"cache{k}"] = np.asarray(healed).copy()
+            if not np.array_equal(np.asarray(first), np.asarray(healed)):
+                events.append({"event": "cache_heal_drift", "point": k})
+        return out
+
+    def check(self, golden: dict, record) -> list:
+        from fia_tpu.chaos.oracles import OracleFailure
+
+        failures = []
+        for e in record.events:
+            if e.get("event") == "cache_heal_drift":
+                failures.append(OracleFailure(
+                    "cache_self_heal",
+                    f"healed cache entry for point {e['point']} is not "
+                    "bit-identical to its first computation",
+                ))
+        if record.error is None and record.workdir:
+            jpath = os.path.join(record.workdir, "journal.jsonl")
+            if os.path.exists(jpath):
+                fp = self.engine.journal_fingerprint(
+                    self.pts, batch_queries=self.BQ)
+                try:
+                    j = Journal.open(jpath, fp, resume=True, fsync=False)
+                    if j.corrupt_lines:
+                        failures.append(OracleFailure(
+                            "journal_consistency",
+                            f"{j.corrupt_lines} corrupt journal line(s) "
+                            "after a clean completion",
+                        ))
+                    j.close()
+                except Exception as e:
+                    failures.append(OracleFailure(
+                        "journal_consistency",
+                        f"journal reopen failed: {e!r}",
+                    ))
+        return failures
+
+
+class ServeStreamScenario(Scenario):
+    """A deterministic request stream under overload + dispatch faults.
+
+    Two submit waves sized past the admission queue bound produce
+    deterministic ``overload``/``invalid`` rejections; admitted keys
+    resolve through hot/disk cache tiers and micro-batched dispatches.
+    Benign schedules (disk-tier damage only) must reproduce the golden
+    stream bit-identically; under dispatch faults the scenario oracle
+    still requires every OK response to match golden byte-for-byte and
+    every rejection to carry a classified or admission reason.
+    """
+
+    name = "serve_stream"
+    MAX_BATCH, MAX_QUEUE, WAVE = 3, 6, 9
+    # 4 guaranteed micro-batch dispatches; 10 disk-tier publishes on a
+    # shed-free run (benign damage never sheds), but only the first
+    # publish is guaranteed once full-domain dispatch faults can shed
+    # whole batches.
+    benign_domain = {
+        sites.SERVE_CACHE_PUBLISH: (_DAMAGE_KINDS, 10),
+    }
+    full_domain = {
+        sites.SERVE_DISPATCH: (
+            (taxonomy.WORKER, taxonomy.OOM, taxonomy.DEADLINE), 4),
+        sites.SERVE_CACHE_PUBLISH: (_DAMAGE_KINDS, 1),
+        sites.CHAOS_SCENARIO: ((taxonomy.WORKER,), 1),
+    }
+
+    def __init__(self):
+        from fia_tpu.data.dataset import RatingDataset
+        from fia_tpu.influence.engine import InfluenceEngine
+        from fia_tpu.models import MF
+
+        import jax
+
+        x, y = _toy_data(0, 400)
+        self.model = MF(_U, _I, _K, _WD)
+        params = self.model.init_params(jax.random.PRNGKey(0))
+        self.engine = InfluenceEngine(
+            self.model, params, RatingDataset(x, y), damping=_DAMP,
+            model_name="chaos-serve")
+        # 12 distinct keys; the stream below replays some of them
+        rng = np.random.default_rng(2)
+        flat = rng.choice(_U * _I, size=12, replace=False)
+        self.keys = [(int(k // _I), int(k % _I)) for k in flat]
+
+    def _stream(self):
+        k = self.keys
+        # wave 1: 6 distinct admits fill the queue, then one invalid id
+        # and two duplicates shed as overload
+        wave1 = k[:6] + [(-1, 5), k[0], k[1]]
+        # wave 2: two hot-cache replays + 4 new keys admit, then 2 new
+        # keys and a replay shed as overload
+        wave2 = [k[0], k[1]] + k[6:10] + k[10:12] + [k[2]]
+        return wave1 + wave2
+
+    def run(self, workdir: str, events: list) -> dict:
+        from fia_tpu.serve.service import InfluenceService, ServeConfig
+
+        eng = self.engine
+        eng.cache_dir = os.path.join(workdir, "cache")
+        svc = InfluenceService(
+            engine=eng,
+            config=ServeConfig(max_batch=self.MAX_BATCH,
+                               max_queue=self.MAX_QUEUE),
+            clock=rpolicy.VirtualClock(),
+        )
+        from fia_tpu.serve.request import Request
+
+        reqs = [Request(u, i, id=f"q{n}")
+                for n, (u, i) in enumerate(self._stream())]
+        responses = svc.run(reqs, drain_every=self.WAVE)
+        out: dict = {}
+        for r in responses:
+            out[f"{r.id}:status"] = f"{r.status}/{r.reason or ''}"
+            if r.ok:
+                out[f"{r.id}:scores"] = np.asarray(r.scores).copy()
+        stats = svc.cache.stats
+        out["shed_batches"] = sum(
+            1 for e in events if e.get("event") == "batch_shed")
+        events.append({"event": "cache_stats",
+                       "hits_hot": int(stats.hits_hot),
+                       "hits_disk": int(stats.hits_disk)})
+        return out
+
+    def check(self, golden: dict, record) -> list:
+        from fia_tpu.chaos.oracles import OracleFailure, _value_diff
+        from fia_tpu.serve import admission
+
+        if record.error is not None or record.outcome is None:
+            return []
+        failures = []
+        got = record.outcome
+        allowed = {
+            taxonomy.OOM, taxonomy.HOST_OOM, taxonomy.AMBIGUOUS,
+            taxonomy.WORKER, taxonomy.PREEMPTION, taxonomy.NAN,
+            taxonomy.DEADLINE,
+            admission.REASON_OVERLOAD, admission.REASON_INVALID,
+        }
+        for name, g in golden.items():
+            if name.endswith(":status"):
+                rid = name[:-len(":status")]
+                gs = str(g)
+                cs = str(got.get(name, "<missing>"))
+                # admission decisions (overload/invalid) are a pure
+                # function of the submit stream — faults cannot move them
+                for adm in (admission.REASON_OVERLOAD,
+                            admission.REASON_INVALID):
+                    if (gs.endswith("/" + adm)) != (cs.endswith("/" + adm)):
+                        failures.append(OracleFailure(
+                            "admission_determinism",
+                            f"{rid}: golden {gs} vs chaos {cs}",
+                        ))
+                if cs.startswith("rejected/"):
+                    reason = cs.split("/", 1)[1]
+                    if reason not in allowed:
+                        failures.append(OracleFailure(
+                            "classified_rejection",
+                            f"{rid}: unclassified rejection {reason!r}",
+                        ))
+            elif name.endswith(":scores") and name in got:
+                # every answer actually served must match golden bytes
+                d = _value_diff(name, g, got[name])
+                if d:
+                    failures.append(OracleFailure("served_bit_identity", d))
+        return failures
+
+
+def make_scenarios() -> dict:
+    """Fresh scenario registry (instances are lazily constructed so the
+    selftest path never imports jax)."""
+    return {
+        SelftestScenario.name: SelftestScenario,
+        SelftestBrokenScenario.name: SelftestBrokenScenario,
+        TrainResumeScenario.name: TrainResumeScenario,
+        QueryCacheScenario.name: QueryCacheScenario,
+        ServeStreamScenario.name: ServeStreamScenario,
+    }
+
+
+SCENARIO_NAMES = tuple(make_scenarios())

@@ -50,6 +50,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from fia_tpu.reliability import sites as _sites
 from fia_tpu.reliability import taxonomy
 
 # Artifact-corruption kinds (the damage channel). Not taxonomy kinds:
@@ -116,11 +117,32 @@ class Fault:
     fired: bool = field(default=False, compare=False)
 
 
-class Injector:
-    """Counts calls per site and fires the scheduled faults."""
+class UnfiredFaultError(ValueError):
+    """Armed faults never fired — the plan did not test what it thinks.
 
-    def __init__(self, faults):
+    A fault armed at a site the workload never reaches (or at a call
+    index past the site's actual call count) is a silent no-op: the
+    test passes without exercising the recovery path it scripts. Chaos
+    schedules depend on the ``armed ⇒ fired or reported`` contract, so
+    :func:`active` reports leftovers loudly at teardown — as a printed
+    warning by default, as this error under ``strict=True``.
+    """
+
+
+class Injector:
+    """Counts calls per site and fires the scheduled faults.
+
+    ``validate=True`` checks every armed site against the
+    :mod:`~fia_tpu.reliability.sites` registry at arm time (chaos
+    schedules always validate; hand-written unit-test plans may use
+    synthetic site names and default to unvalidated).
+    """
+
+    def __init__(self, faults, validate: bool = False):
         self.faults = list(faults)
+        if validate:
+            for f in self.faults:
+                _sites.check(f.site)
         self.counts: dict[str, int] = {}
         self.log: list[tuple[str, int, str]] = []
 
@@ -207,6 +229,16 @@ class Injector:
     def unfired(self) -> list[Fault]:
         return [f for f in self.faults if not f.fired]
 
+    def report(self) -> dict:
+        """Machine-readable fault accounting for oracles and repro
+        files: per-site call counts, faults that fired (site, index,
+        kind), and armed faults that never fired."""
+        return {
+            "counts": dict(self.counts),
+            "fired": [list(entry) for entry in self.log],
+            "unfired": [[f.site, f.at, f.kind] for f in self.unfired()],
+        }
+
 
 _active: Injector | None = None
 
@@ -244,20 +276,43 @@ def call_count(site: str) -> int:
 
 
 @contextmanager
-def active(*faults: Fault):
+def active(*faults: Fault, strict: bool = False, validate: bool = False):
     """Arm a fault plan for the duration of the block.
 
     Yields the :class:`Injector` so tests can inspect ``log``/
     ``counts``/``unfired`` afterwards. Nesting is rejected — overlapping
     plans would make schedules ambiguous.
+
+    Armed ⇒ fired or reported: a fault left unfired at teardown (a site
+    the workload never reached, or an ``at`` index past the site's call
+    count) is printed as a loud warning; under ``strict=True`` it
+    raises :class:`UnfiredFaultError` instead — unless the block is
+    already unwinding with an exception, which the leftover report must
+    not mask. ``validate=True`` rejects unregistered site names at arm
+    time (see :class:`Injector`).
     """
     global _active
     if _active is not None:
         # fialint: disable=FIA302 -- nesting misuse is a harness bug, not a classifiable fault; tests pin the RuntimeError type
         raise RuntimeError("a fault-injection plan is already armed")
-    inj = Injector(faults)
+    inj = Injector(faults, validate=validate)
     _active = inj
+    completed = False
     try:
         yield inj
+        completed = True
     finally:
         _active = None
+        leftovers = inj.unfired()
+        if leftovers:
+            desc = ", ".join(
+                f"{f.site}@{f.at}:{f.kind}" for f in leftovers
+            )
+            msg = (
+                f"{len(leftovers)} armed fault(s) never fired ({desc}) — "
+                "the workload never reached those (site, call-index) "
+                "points, so the plan did not test what it scripts"
+            )
+            if strict and completed:
+                raise UnfiredFaultError(msg)
+            print(f"[inject] WARNING: {msg}")

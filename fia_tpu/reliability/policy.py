@@ -14,6 +14,11 @@ runtime and CLI drivers:
   the original failure instead of sleeping through it) and with the
   journaled drivers (expiry is a clean, resumable stop — kind
   ``DEADLINE`` — not an error).
+- :class:`Clock` — the injectable monotonic time source behind both.
+  Production defaults to :data:`WALL` (``time.monotonic`` /
+  ``time.sleep``); tests and the chaos engine pass a
+  :class:`VirtualClock` so deadline expiry and backoff schedules run
+  in virtual time with zero real sleeps.
 - the solver degradation ladders — ``next_solver`` encodes the
   fallback order for diverging/NaN iHVP solves: ``lissa → cg →
   direct`` for the block engine (``schulz`` falls back to ``direct``
@@ -40,21 +45,70 @@ def _mix64(*vals: int) -> int:
     return h
 
 
+class Clock:
+    """Injectable monotonic time source (the wall-clock behavior).
+
+    One object carries both halves of time — reading it
+    (:meth:`monotonic`) and spending it (:meth:`sleep`) — so a policy
+    that backs off and a deadline that expires agree on what "now"
+    means. Call sites default to the module singleton :data:`WALL`.
+    """
+
+    def monotonic(self) -> float:
+        return _time.monotonic()
+
+    def sleep(self, seconds: float) -> None:
+        if seconds > 0.0:
+            _time.sleep(seconds)
+
+
+WALL = Clock()
+
+
+class VirtualClock(Clock):
+    """Deterministic virtual time: ``sleep`` advances ``monotonic``
+    instantly.
+
+    The chaos engine and the deadline tests run entire retry/deadline
+    interactions — backoff schedules, mid-run expiry, refusing to sleep
+    past a budget — in zero wall time, with the elapsed virtual time
+    observable and exactly reproducible.
+    """
+
+    def __init__(self, start: float = 0.0):
+        self._now = float(start)
+
+    def monotonic(self) -> float:
+        return self._now
+
+    def sleep(self, seconds: float) -> None:
+        self._now += max(float(seconds), 0.0)
+
+    def advance(self, seconds: float) -> None:
+        """Move time forward without a sleeper (an external event)."""
+        self._now += float(seconds)
+
+
 class Deadline:
-    """A wall-clock budget on a unit of work.
+    """A monotonic-clock budget on a unit of work.
 
     ``seconds=None`` (or <= 0) is the unbounded deadline — every check
     passes — so call sites can thread one object unconditionally.
+    ``clock`` injects the time source (default :data:`WALL`); a
+    :class:`VirtualClock` makes expiry a pure function of scripted
+    sleeps.
     """
 
-    def __init__(self, seconds: float | None = None):
+    def __init__(self, seconds: float | None = None,
+                 clock: Clock | None = None):
         self.seconds = None if not seconds or seconds <= 0 else float(seconds)
-        self._t0 = _time.monotonic()
+        self.clock = WALL if clock is None else clock
+        self._t0 = self.clock.monotonic()
 
     def remaining(self) -> float:
         if self.seconds is None:
             return float("inf")
-        return self.seconds - (_time.monotonic() - self._t0)
+        return self.seconds - (self.clock.monotonic() - self._t0)
 
     def expired(self) -> bool:
         return self.remaining() <= 0.0
@@ -107,7 +161,8 @@ class RetryPolicy:
         retry_on: Iterable[str] = taxonomy.TRANSIENT,
         classify: Callable[[BaseException], str | None] = taxonomy.classify,
         deadline: Deadline | None = None,
-        sleep: Callable[[float], None] = _time.sleep,
+        sleep: Callable[[float], None] | None = None,
+        clock: Clock | None = None,
         on_retry: Callable[[str, int, BaseException], None] | None = None,
     ):
         """Call ``fn`` with bounded retries on classified-transient
@@ -118,8 +173,12 @@ class RetryPolicy:
         overshoot ``deadline`` (sleeping past a budget only delays the
         inevitable surfacing). ``on_retry(kind, attempt, exc)`` runs
         before each backoff — recovery hooks (device-state rebuilds)
-        and logging go there.
+        and logging go there. Backoff sleeps go through ``sleep`` when
+        given, else ``clock.sleep`` (default :data:`WALL`) — a
+        :class:`VirtualClock` runs the whole schedule in virtual time.
         """
+        if sleep is None:
+            sleep = (WALL if clock is None else clock).sleep
         retry_on = frozenset(retry_on)
         attempts = max(int(self.max_attempts), 1)
         for attempt in range(attempts):

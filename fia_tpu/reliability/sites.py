@@ -52,6 +52,10 @@ ARTIFACTS_PUBLISH = "artifacts.publish"
 SERVE_DISPATCH = "serve.dispatch"
 SERVE_CACHE_PUBLISH = "serve.cache_publish"
 
+# -- chaos scenario engine ---------------------------------------------
+CHAOS_SCENARIO = "chaos.scenario"
+CHAOS_UNIT = "chaos.unit"
+
 ALL_SITES = frozenset({
     ENGINE_UPLOAD,
     ENGINE_DISPATCH_FLAT,
@@ -66,6 +70,8 @@ ALL_SITES = frozenset({
     ARTIFACTS_PUBLISH,
     SERVE_DISPATCH,
     SERVE_CACHE_PUBLISH,
+    CHAOS_SCENARIO,
+    CHAOS_UNIT,
 })
 
 

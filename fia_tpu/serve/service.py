@@ -76,8 +76,10 @@ class InfluenceService:
         :class:`~fia_tpu.api.FIAModel` transparently swaps a fresh
         engine in and the fingerprinted cache keys retire stale entries.
       config: a :class:`ServeConfig`.
-      clock: monotonic-seconds callable (injectable for deterministic
-        tests and simulated open-loop load).
+      clock: monotonic-seconds callable, or a
+        :class:`fia_tpu.reliability.policy.Clock` object (its
+        ``monotonic`` method is used) — injectable for deterministic
+        tests, simulated open-loop load, and virtual-time chaos runs.
     """
 
     def __init__(self, engine=None, engine_provider=None,
@@ -88,7 +90,8 @@ class InfluenceService:
         self._engine_static = engine
         self._engine_provider = engine_provider
         self.config = config or ServeConfig()
-        self.clock = clock
+        # a policy.Clock (e.g. VirtualClock) normalises to its reader
+        self.clock = getattr(clock, "monotonic", clock)
         self.cache = HotBlockCache(self.config.cache_entries,
                                    self.config.cache_bytes)
         self.metrics = ServeMetrics(self.config.metrics_path)

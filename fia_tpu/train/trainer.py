@@ -61,10 +61,16 @@ class Trainer:
     positions, which the weighted-mean loss ignores exactly."""
 
     def __init__(self, model, config: TrainConfig, event_log=None, mesh=None,
-                 retry_policy: "rpolicy.RetryPolicy | None" = None):
+                 retry_policy: "rpolicy.RetryPolicy | None" = None,
+                 clock: "rpolicy.Clock | None" = None):
         self.model = model
         self.config = config
         self.retry_policy = _TRAIN_RETRY if retry_policy is None else retry_policy
+        # Injectable time source for retry backoff: the chaos engine
+        # trains under virtual time so an injected transient epoch fault
+        # costs zero wall-clock sleep while the backoff schedule itself
+        # stays the production one.
+        self.clock = rpolicy.WALL if clock is None else clock
         self.optimizer = optax.adam(config.learning_rate)
         self.sgd = optax.sgd(config.learning_rate * 10.0)
         self.event_log = event_log  # utils.logging.EventLog or None
@@ -247,7 +253,8 @@ class Trainer:
             # functional inputs are reused verbatim on retry, so a
             # transient worker death replays this epoch segment exactly
             params, opt_state, losses = self.retry_policy.run(
-                dispatch_epoch, retry_on=taxonomy.TRANSIENT
+                dispatch_epoch, retry_on=taxonomy.TRANSIENT,
+                clock=self.clock,
             )
             done += todo
             if checkpointer is not None:
@@ -380,6 +387,7 @@ def loo_retrain_many(
     steps_per_dispatch: int = 2000,
     mesh=None,
     retry_policy: "rpolicy.RetryPolicy | None" = None,
+    clock: "rpolicy.Clock | None" = None,
 ):
     """Leave-one-out retraining, vmapped over removed points.
 
@@ -478,7 +486,8 @@ def loo_retrain_many(
         # at the dispatch boundary (the observed tunnel/worker class,
         # and everything the injection harness schedules) retry cleanly.
         params, opt_state, t = pol.run(dispatch_seg,
-                                       retry_on=taxonomy.TRANSIENT)
+                                       retry_on=taxonomy.TRANSIENT,
+                                       clock=clock)
     return (
         params
         if R == R_real

@@ -164,15 +164,24 @@ def _pid_alive(pid: int) -> bool:
     return True
 
 
-def sweep_stale_tmps(dirpath: str) -> list[str]:
+def sweep_stale_tmps(
+    dirpath: str, age_horizon_s: float = 6 * 3600.0
+) -> list[str]:
     """Remove temp files abandoned by a killed writer; return them.
 
     A kill between write and rename leaves ``.npztmp.<pid>.*.npz`` /
     ``*.tmp.<pid>.npz`` droppings that would otherwise accumulate
     forever. A temp file is provably stale when its embedded pid is no
-    longer a live process; files whose writer is still alive (including
-    this process) are untouched. pid-less manifest temps are swept only
-    when their mtime is over an hour old.
+    longer a live process. A *live* pid is not proof of ownership —
+    pids are recycled, so a kill-loop (the chaos engine's
+    train→kill→resume scenario, or any supervisor that restarts
+    writers) can leave a dropping whose pid now names an unrelated
+    process, which the pid probe would protect forever. The age
+    fallback breaks that tie: a temp file older than ``age_horizon_s``
+    (default 6 h — no atomic publish holds its temp open that long) is
+    sweepable regardless of what its embedded pid looks like today.
+    pid-less manifest temps are swept only when their mtime is over an
+    hour old.
     """
     removed: list[str] = []
     if not os.path.isdir(dirpath):
@@ -187,7 +196,9 @@ def sweep_stale_tmps(dirpath: str) -> list[str]:
             full = os.path.join(dirpath, name)
             pid = int(m.group(1)) if m.group(1) else None
             stale = (
-                not _pid_alive(pid) if pid is not None
+                (not _pid_alive(pid)
+                 or _older_than(full, age_horizon_s, time.time()))
+                if pid is not None
                 else _older_than(full, 3600.0, time.time())
             )
             if stale:

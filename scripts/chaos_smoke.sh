@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# Chaos smoke: the fixed-seed benign schedule battery through
+# fia_tpu.cli.chaos on CPU, asserting (in-process, see chaos/runner):
+#   - every scenario run under a benign fault schedule reproduces its
+#     undisturbed golden run bit-identically
+#   - every run error is taxonomy-classified; armed faults fired
+#   - damaged artifacts are detectable, quarantined, never re-read
+#
+#   bash scripts/chaos_smoke.sh        (or: make chaos-smoke)
+#
+# Budget: <60s on CPU — tiny MF workloads, shared compiled scenario
+# state across runs, virtual-clock retries (no wall sleeps). Run dirs
+# and repro files land in a throwaway tmpdir so repeated runs stay
+# hermetic; on failure the repro JSON path is printed before cleanup.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+DIR=$(mktemp -d /tmp/fia_chaos_smoke.XXXXXX)
+trap 'rm -rf "$DIR"' EXIT
+
+JAX_PLATFORMS=cpu timeout -k 10 300 python -m fia_tpu.cli.chaos \
+  --smoke --workdir "$DIR"
+
+echo "chaos-smoke PASS"

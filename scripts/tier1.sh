@@ -16,6 +16,14 @@ python -m fia_tpu.analysis.lint fia_tpu scripts bench.py || {
   echo "fialint FAILED (see findings above; docs/lint.md for the rules)"
   exit 1
 }
+# Chaos smoke next, FATAL: fixed-seed benign fault schedules must
+# reproduce golden runs bit-identically (docs/reliability.md, "Chaos
+# scenarios"). A failure here is a reliability-contract regression and
+# the smoke prints a replayable repro JSON before exiting.
+bash scripts/chaos_smoke.sh || {
+  echo "chaos-smoke FAILED (see repro path above; run make chaos-smoke)"
+  exit 1
+}
 # Serving smoke next, NON-fatal: the pinned tier-1 verdict below stays
 # exactly the ROADMAP.md pytest command, the smoke just surfaces
 # serving regressions in the same log.

@@ -176,6 +176,25 @@ class TestDurability:
             assert os.path.exists(tmp_path / n) != should_go, n
         assert len(removed) == 2
 
+    def test_sweep_age_horizon_breaks_pid_recycling_tie(self, tmp_path):
+        """A live pid is not proof of ownership — pids recycle, so a
+        kill-loop can leave a dropping whose embedded pid now names an
+        unrelated live process. Past the age horizon it is swept anyway;
+        a fresh temp under the same live pid stays protected."""
+        import time
+
+        live = os.getpid()
+        old = tmp_path / f".npztmp.{live}.old.npz"
+        fresh = tmp_path / f".npztmp.{live}.new.npz"
+        for p in (old, fresh):
+            p.write_bytes(b"x")
+        past = time.time() - 120.0
+        os.utime(old, (past, past))
+        removed = uio.sweep_stale_tmps(str(tmp_path), age_horizon_s=60.0)
+        assert removed == [str(old)]
+        assert not os.path.exists(old)
+        assert os.path.exists(fresh)  # an in-flight write, never swept
+
 
 class TestCheckpointValidation:
     def _params(self):
@@ -424,6 +443,54 @@ class TestTrainerAutoResume:
         trainer_c, state_c, _ = common.train_or_load(
             args_b, model, params, splits, verbose=False)
         _leaves_equal(state_c.params, state_a.params)
+
+    def test_restore_exhaustion_falls_back_to_scratch(self, tmp_path):
+        """Every rotated generation corrupt (satellite: restore-ladder
+        exhaustion): `train_or_load` must quarantine them all as it
+        walks, land on from-scratch training (same params as a clean
+        run — the schedule is seed-deterministic), and keep the
+        quarantined evidence on disk."""
+        from fia_tpu.cli import common
+
+        def make_args(train_dir):
+            return common.base_parser("t").parse_args([
+                "--dataset", "synthetic", "--model", "MF",
+                "--synth_users", "40", "--synth_items", "30",
+                "--synth_train", "1200", "--synth_test", "40",
+                "--num_steps_train", "32", "--batch_size", "150",
+                "--checkpoint_every", "8", "--train_dir", str(train_dir),
+                "--embed_size", "4", "--log_file", "none",
+            ])
+
+        args_a = make_args(tmp_path / "a")
+        splits = common.load_splits(args_a)
+        model, params = common.build_model(args_a, splits)
+        _, state_a, _ = common.train_or_load(
+            args_a, model, params, splits, verbose=False)
+
+        args_b = make_args(tmp_path / "b")
+        with inject.active(
+            inject.Fault("trainer.epoch", at=2, kind=taxonomy.OOM)
+        ):
+            with pytest.raises(RuntimeError):
+                common.train_or_load(args_b, model, params, splits,
+                                     verbose=False)
+        ckdir = next(
+            str(tmp_path / "b" / d) for d in os.listdir(tmp_path / "b")
+            if d.endswith("-ckpts"))
+        gens = checkpoint.generations(ckdir)
+        assert len(gens) == 2  # the kill left two generations behind
+        for _, path in gens:
+            os.truncate(path, os.path.getsize(path) // 2)
+
+        _, state_b, _ = common.train_or_load(
+            args_b, model, params, splits, verbose=False)
+        assert state_b.step == 32
+        _leaves_equal(state_b.params, state_a.params)  # true from-scratch
+        # exhaustion quarantined every generation — evidence, not deletion
+        assert checkpoint.generations(ckdir) != []  # fresh run re-published
+        corrupt = [n for n in os.listdir(ckdir) if ".corrupt" in n]
+        assert len(corrupt) >= len(gens)
 
     def test_corrupt_terminal_checkpoint_falls_through(self, tmp_path):
         """A corrupt terminal checkpoint must not crash the driver: it

@@ -143,6 +143,7 @@ class TestPolicy:
     def test_run_refuses_to_sleep_past_deadline(self):
         slow = rpolicy.RetryPolicy(max_attempts=4, base_delay=100.0,
                                    jitter=0.0)
+        vc = rpolicy.VirtualClock()
         calls = []
 
         def always():
@@ -150,16 +151,53 @@ class TestPolicy:
             raise RuntimeError(inject.MESSAGES[taxonomy.WORKER])
 
         with pytest.raises(RuntimeError):
-            slow.run(always, deadline=rpolicy.Deadline(0.5))
+            slow.run(always, deadline=rpolicy.Deadline(0.5, clock=vc),
+                     clock=vc)
         assert len(calls) == 1  # surfaced instead of a 100 s sleep
+        assert vc.monotonic() == 0.0  # refused: no backoff was slept
 
     def test_deadline(self):
         assert not rpolicy.Deadline(None).expired()
         assert rpolicy.Deadline(0.0).remaining() == float("inf")
-        d = rpolicy.Deadline(1e-9)
+        # expiry is a pure function of (virtual) elapsed time — the old
+        # wall-clock version relied on 1e-9 s passing between two lines
+        vc = rpolicy.VirtualClock()
+        d = rpolicy.Deadline(1.0, clock=vc)
+        assert not d.expired() and d.remaining() == 1.0
+        vc.advance(0.75)
+        assert d.remaining() == pytest.approx(0.25)
+        vc.advance(0.5)
         assert d.expired()
         with pytest.raises(taxonomy.DeadlineExpired):
             d.check("unit test")
+
+    def test_backoff_runs_entirely_in_virtual_time(self):
+        pol = rpolicy.RetryPolicy(max_attempts=4, base_delay=2.0,
+                                  max_delay=30.0, jitter=0.25, seed=3)
+        vc = rpolicy.VirtualClock()
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 4:
+                raise RuntimeError(inject.MESSAGES[taxonomy.WORKER])
+            return "ok"
+
+        import time
+        t0 = time.monotonic()
+        assert pol.run(flaky, clock=vc) == "ok"
+        # the full multi-second backoff schedule elapsed on the virtual
+        # clock, and essentially none of it on the wall
+        assert vc.monotonic() == pytest.approx(sum(pol.delays()))
+        assert time.monotonic() - t0 < 1.0
+
+    def test_virtual_clock_sleep_advances_monotonic(self):
+        vc = rpolicy.VirtualClock(start=5.0)
+        vc.sleep(2.5)
+        vc.sleep(-1.0)  # negative sleeps are clamped, like WALL's
+        assert vc.monotonic() == 7.5
+        vc.advance(0.5)
+        assert vc.monotonic() == 8.0
 
     def test_solver_ladders(self):
         assert rpolicy.next_solver("lissa") == "cg"
@@ -213,6 +251,57 @@ class TestInjector:
             with pytest.raises(RuntimeError, match="already armed"):
                 with inject.active():
                     pass
+
+    def test_unfired_fault_warns_at_teardown(self, capsys):
+        # armed ⇒ fired or reported: a plan the workload never reaches
+        # is a silent no-op unless the teardown says so
+        with inject.active(
+            inject.Fault("site.a", at=7, kind=taxonomy.WORKER)
+        ):
+            inject.fire("site.a")  # idx 0 only — at=7 never reached
+        out = capsys.readouterr().out
+        assert "never fired" in out and "site.a@7:worker" in out
+
+    def test_unfired_fault_strict_raises(self):
+        with pytest.raises(inject.UnfiredFaultError,
+                           match="site.a@3:worker"):
+            with inject.active(
+                inject.Fault("site.a", at=3, kind=taxonomy.WORKER),
+                strict=True,
+            ):
+                inject.fire("site.a")
+        assert inject.call_count("site.a") == 0  # plan was disarmed
+
+    def test_strict_never_masks_inflight_exception(self):
+        # a block already unwinding keeps ITS exception; the unfired
+        # report must not replace a real failure with bookkeeping
+        with pytest.raises(ValueError, match="real failure"):
+            with inject.active(
+                inject.Fault("site.a", at=9, kind=taxonomy.WORKER),
+                strict=True,
+            ):
+                raise ValueError("real failure")
+
+    def test_validate_rejects_unregistered_site(self):
+        with pytest.raises(ValueError, match="unknown injection site"):
+            with inject.active(
+                inject.Fault("no.such.site", at=0, kind=taxonomy.WORKER),
+                validate=True,
+            ):
+                pass  # pragma: no cover — arm-time rejection
+
+    def test_report_accounts_fired_and_unfired(self):
+        with inject.active(
+            inject.Fault("site.a", at=0, kind=taxonomy.WORKER),
+            inject.Fault("site.b", at=5, kind=taxonomy.OOM),
+        ) as inj:
+            with pytest.raises(RuntimeError):
+                inject.fire("site.a")
+            inject.fire("site.a")
+        rep = inj.report()
+        assert rep["counts"] == {"site.a": 2}
+        assert rep["fired"] == [["site.a", 0, taxonomy.WORKER]]
+        assert rep["unfired"] == [["site.b", 5, taxonomy.OOM]]
 
 
 class TestJournal:
@@ -410,10 +499,13 @@ class TestEngineRecovery:
         pts = np.asarray(train.x[:4])
         path = str(tmp_path / "dl.jsonl")
         fp = eng.journal_fingerprint(pts, batch_queries=2)
+        vc = rpolicy.VirtualClock()
+        expired = rpolicy.Deadline(1.0, clock=vc)
+        vc.advance(2.0)  # deterministic expiry, no wall-clock race
         with Journal.open(path, fp, fsync=False) as j:
             with pytest.raises(taxonomy.DeadlineExpired):
                 eng.query_many(pts, batch_queries=2, journal=j,
-                               deadline=rpolicy.Deadline(1e-9))
+                               deadline=expired)
         base = eng.query_many(pts, batch_queries=2)
         with Journal.open(path, fp, resume=True, fsync=False) as j2:
             got = eng.query_many(pts, batch_queries=2, journal=j2)
