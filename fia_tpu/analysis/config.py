@@ -26,6 +26,35 @@ REGISTERED_JIT_ENTRY_POINTS = (
     ("fia_tpu/influence/engine.py", "_query_one"),
 )
 
+# FIA204: the registered dispatch hot path. These functions sit between
+# "a batch of queries exists on the host" and "one fused device program
+# runs"; the mega-batch design (docs/design.md §14) moves data
+# host→device once per *dispatch*, never once per *query*. A transfer
+# call lexically inside a Python loop in one of them reintroduces the
+# per-query dispatch wall the fused path exists to kill. Entries are
+# (path suffix, bare function name), like REGISTERED_JIT_ENTRY_POINTS.
+DISPATCH_PATH_FUNCTIONS = (
+    ("fia_tpu/influence/engine.py", "_dispatch_flat"),
+    ("fia_tpu/influence/engine.py", "_finalize_flat"),
+    ("fia_tpu/influence/engine.py", "query_many"),
+    ("fia_tpu/serve/service.py", "_dispatch_misses"),
+    ("fia_tpu/serve/service.py", "drain"),
+)
+
+# Call names FIA204 treats as host→device transfer initiators when they
+# appear inside a loop on the dispatch path. jnp.asarray/jnp.array on
+# host data IS a transfer (plus a possible copy); put_global is the
+# mesh-aware equivalent.
+DISPATCH_TRANSFER_CALLS = frozenset({
+    "jax.device_put",
+    "device_put",
+    "put_global",
+    "jnp.asarray",
+    "jnp.array",
+    "jax.numpy.asarray",
+    "jax.numpy.array",
+})
+
 # FIA302 applies to files whose repo-relative path starts with:
 RELIABILITY_PREFIX = "fia_tpu/reliability/"
 

@@ -1,4 +1,9 @@
-"""FIA201/202/203 — trace hygiene inside jit-traced functions.
+"""FIA201/202/203/204 — trace and dispatch hygiene.
+
+FIA201–203 police jit-traced function bodies; FIA204 polices the
+*host-side* dispatch path (the registered functions that pack a batch
+and launch one fused device program), where the hazard is per-query
+host→device transfers rather than trace-time syncs.
 
 The serving path's latency contract rests on the pad-bucket discipline:
 every hot dispatch reuses a compiled program. The three ways that
@@ -33,6 +38,7 @@ from __future__ import annotations
 
 import ast
 
+from fia_tpu.analysis import config
 from fia_tpu.analysis.core import FileRule, Finding, SourceFile, register
 from fia_tpu.analysis.visitor import (
     call_name,
@@ -261,4 +267,56 @@ class ClosureCaptureRule(FileRule):
                     "buffer per distinct array); pass them as traced "
                     "arguments",
                 ))
+        return findings
+
+
+def _loop_body_calls(fn: ast.FunctionDef):
+    """Calls lexically inside a loop body of ``fn``, skipping nested
+    defs/lambdas: a closure built in a loop is deferred code (the
+    serving path stores retry thunks that way), not a per-iteration
+    transfer, so flagging it would punish the escape hatch the rule
+    wants to preserve."""
+    def rec(node, in_loop):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            inner = in_loop or isinstance(child, (ast.For, ast.While))
+            if in_loop and isinstance(child, ast.Call):
+                yield child
+            yield from rec(child, inner)
+    yield from rec(fn, False)
+
+
+@register
+class DispatchTransferRule(FileRule):
+    """Per-query host transfers inside registered dispatch-path loops."""
+
+    id = "FIA204"
+    name = "per-query-transfer-in-dispatch"
+
+    def check(self, sf: SourceFile):
+        wanted = {
+            name for path, name in config.DISPATCH_PATH_FUNCTIONS
+            if sf.rel.endswith(path)
+        }
+        if not wanted:
+            return []
+        findings: list[Finding] = []
+        for node in ast.walk(sf.tree):
+            if not (isinstance(node, ast.FunctionDef)
+                    and node.name in wanted):
+                continue
+            for call in _loop_body_calls(node):
+                cn = call_name(call)
+                if cn in config.DISPATCH_TRANSFER_CALLS:
+                    findings.append(Finding(
+                        self.id, sf.rel, call.lineno, call.col_offset,
+                        f"host→device transfer {cn}() inside a loop in "
+                        f"dispatch-path function {node.name!r} — the "
+                        "fused mega-batch contract (docs/design.md §14) "
+                        "is one transfer per dispatch, never per query; "
+                        "hoist it above the loop or pack the batch "
+                        "first",
+                    ))
         return findings

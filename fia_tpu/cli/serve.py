@@ -7,13 +7,16 @@ line (``{"user": u, "item": i, "id": ..., "deadline_s": ...}`` — bare
 (the ``serve.request`` schema of fia_tpu/serve/metrics.py plus the
 score payload).
 
-Modes (mutually exclusive, checked in this order):
+Modes (checked in this order; ``--warmup`` composes with the others):
 
-- ``--warmup N``: plan and dispatch the micro-batches the scheduler
-  would build for N representative test points (the pad-bucket ladder),
-  print the compiled program keys, and exit. Run it before pointing
-  traffic at a fresh process — the first query of a cold bucket
-  otherwise pays its compile inside someone's latency budget.
+- ``--warmup N``: AOT pre-lower + compile the flat dispatch geometries
+  of the micro-batches the scheduler would plan for N representative
+  test points, then dispatch those batches once (autotune warm). Exits
+  nonzero when any planned geometry is left uncompiled — a cold bucket
+  would otherwise pay its compile inside someone's latency budget.
+  Standalone it reports and exits; combined with ``--smoke_requests``
+  or the stdin loop it arms the caches first and the traffic mode runs
+  on a warm, never-compiling hot path.
 - ``--smoke_requests N``: self-contained synthetic open-loop stream — N
   queries over the test split with a repeat-heavy hot set — then a
   latency/cache report. Exits nonzero unless every request either
@@ -39,9 +42,12 @@ from fia_tpu.serve import InfluenceService, Request, ServeConfig
 
 
 def add_serve_flags(p):
-    p.add_argument("--max_batch", type=int, default=32,
-                   help="micro-batch coalescing cap per device dispatch")
-    p.add_argument("--max_queue", type=int, default=256,
+    p.add_argument("--max_batch", type=int, default=1024,
+                   help="mega-batch coalescing cap per device dispatch "
+                        "(big fused dispatches amortize the host "
+                        "dispatch wall; dial down when p50 latency "
+                        "matters more than throughput)")
+    p.add_argument("--max_queue", type=int, default=4096,
                    help="admission bound: queued requests beyond this "
                         "are rejected with reason 'overload'")
     p.add_argument("--cache_entries", type=int, default=1024,
@@ -61,8 +67,11 @@ def add_serve_flags(p):
     p.add_argument("--drain_every", type=int, default=32,
                    help="stdin mode: drain the queue every N lines")
     p.add_argument("--warmup", type=int, default=0,
-                   help="precompile the bucket ladder over N test "
-                        "points, report, exit")
+                   help="AOT-precompile the planned dispatch "
+                        "geometries over N test points (nonzero exit "
+                        "when a planned bucket is left uncompiled); "
+                        "alone: report and exit, with a traffic mode: "
+                        "arm first, then serve warm")
     p.add_argument("--smoke_requests", type=int, default=0,
                    help="run an N-request synthetic smoke stream, "
                         "report, exit (nonzero on failure)")
@@ -170,6 +179,11 @@ def run_warmup(svc: InfluenceService, splits, args) -> int:
     pts = np.asarray(splits["test"].x[: args.warmup], np.int64)
     info = svc.warmup(pts)
     print(json.dumps({"event": "serve.warmup", **info}))
+    if not info["all_planned_compiled"]:
+        print("WARMUP FAIL: planned dispatch geometries left "
+              f"uncompiled (planned {info['planned_geometries']}, "
+              f"aot {info['aot']})", file=sys.stderr)
+        return 1
     return 0
 
 
@@ -199,7 +213,9 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
     svc, splits = build_service(args)
     if args.warmup:
-        return run_warmup(svc, splits, args)
+        rc = run_warmup(svc, splits, args)
+        if rc or not args.smoke_requests:
+            return rc
     if args.smoke_requests:
         return run_smoke(svc, splits, args)
     return run_stdin(svc, args)

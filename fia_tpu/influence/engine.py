@@ -24,6 +24,7 @@ compact outputs in a single host round trip (see docs/design.md §2).
 from __future__ import annotations
 
 import os
+import time
 from functools import partial
 
 import jax
@@ -215,6 +216,7 @@ class InfluenceEngine:
         flat_accum: str = "auto",
         row_features: str = "auto",
         cpu_fallback: bool = True,
+        query_bucket: int = 64,
     ):
         if solver not in ("direct", "cg", "lissa", "schulz"):
             raise ValueError(f"unknown solver {solver!r}")
@@ -326,7 +328,23 @@ class InfluenceEngine:
         if flat_accum not in ("auto", "scan", "onehot"):
             raise ValueError(f"unknown flat_accum {flat_accum!r}")
         self.flat_accum = flat_accum
+        # Flat-path query-axis bucket: every dispatch pads its (T, 2)
+        # query ids to bucketed_pad(T, query_bucket) by duplicating the
+        # trailing pair, so mixed-size streams reuse a handful of
+        # compiled geometries AND the batched block solve always runs
+        # at a canonical batch size. The second property is a
+        # bit-exactness contract, not a perf tweak: the batched LU
+        # behind jnp.linalg.solve selects kernels by batch size (CPU
+        # measurably flips low bits below batch ~16), so without a
+        # canonical solve geometry `query_many` chunking would not be
+        # bit-identical to one full dispatch (tests/test_dispatch.py
+        # pins the equivalence). 0 disables (exact-T programs).
+        self.query_bucket = max(0, int(query_bucket))
         self._jitted = {}  # pad length -> compiled batched query
+        # (t_pad, s_pad) geometry -> AOT-compiled flat executable
+        # (jax.jit(...).lower(...).compile()), armed by precompile_flat
+        # at warmup so steady-state dispatches never trace or compile.
+        self._aot = {}
         # Memory-adaptive padded-path state (_query_padded_adaptive):
         # the largest (queries x pad) cell count that dispatched
         # successfully, and the smallest that exhausted device memory.
@@ -449,6 +467,7 @@ class InfluenceEngine:
         fault injection.
         """
         self._jitted.clear()
+        self._aot.clear()  # AOT executables bound the dead client too
         # 8 attempts at 2s base / x2 growth / 30s cap spans ~120s of
         # backoff — the observed worker-restart envelope.
         pol = rpolicy.RetryPolicy(
@@ -536,7 +555,8 @@ class InfluenceEngine:
         return self._jitted[pad]
 
     # -- flat segment-sum query path --------------------------------------
-    def _flat_fn(self, s_pad: int, stage: str = "scores"):
+    def _flat_fn(self, s_pad: int, stage: str = "scores",
+                 donate: bool = False):
         """All queries' related rows concatenated into one flat (S,)
         axis; per-query Hessians accumulated by segment reduction.
 
@@ -557,7 +577,7 @@ class InfluenceEngine:
         best-of-N time differences attribute device cost per stage.
         """
         use_feat = self._rowfeat is not None
-        key = ("flat", s_pad, stage, use_feat)
+        key = ("flat", s_pad, stage, use_feat, donate)
         if key in self._jitted:
             return self._jitted[key]
         if stage not in ("grads", "hessian", "solve", "scores"):
@@ -792,7 +812,12 @@ class InfluenceEngine:
                 v = jax.lax.with_sharding_constraint(v, rep)
             return scores, ihvp, v
 
-        self._jitted[key] = jax.jit(fn)
+        # Donating the (T, 2) query ids — the only per-dispatch
+        # host→device operand — lets XLA reuse their buffer instead of
+        # allocating one per dispatch (every other operand is resident).
+        self._jitted[key] = (
+            jax.jit(fn, donate_argnums=(4,)) if donate else jax.jit(fn)
+        )
         return self._jitted[key]
 
     def _flat_eligible(self) -> bool:
@@ -813,19 +838,27 @@ class InfluenceEngine:
             and self.model.block_reg_diag is not None
         )
 
-    def _dispatch_flat(self, test_points: np.ndarray, pad_to: int | None):
-        """Enqueue one flat query program; returns an opaque handle for
-        :meth:`_finalize_flat`. Dispatch is async — the device starts
-        crunching while the host moves on."""
-        inject.fire(sites.ENGINE_DISPATCH_FLAT)
-        counts = self.index.counts_batch(test_points)
-        total = int(counts.sum())
-        # geometric bucketing (~12.5% granule): pure powers of two waste
-        # up to ~50% device work on padded rows (measured 44% on ML-1M
-        # 256-query batches — the flat program is compute-bound, so
-        # padding is wall-clock). The power-of-two floor keeps S a
-        # multiple of every flat_chunk ≤ floor (the scan reshape needs
-        # chunk | S).
+    def _query_pad(self, T: int) -> int:
+        """Query-axis pad of a flat dispatch (see ``query_bucket``).
+
+        Meshes keep the exact T: the sharded program replicates the
+        query axis and its geometry reuse matters less than leaving the
+        multi-host dispatch layout untouched.
+        """
+        if self.query_bucket <= 0 or self.mesh is not None:
+            return T
+        return bucketed_pad(T, self.query_bucket)
+
+    def _s_pad_for(self, total: int) -> int:
+        """Flat-axis pad for ``total`` related rows.
+
+        Geometric bucketing (~12.5% granule): pure powers of two waste
+        up to ~50% device work on padded rows (measured 44% on ML-1M
+        256-query batches — the flat program is compute-bound, so
+        padding is wall-clock). The power-of-two floor keeps S a
+        multiple of every flat_chunk ≤ floor (the scan reshape needs
+        chunk | S).
+        """
         s_pad = bucketed_pad(total, 2048)
         if self.mesh is not None:
             # the flat axis splits into ndev chunk-aligned shards
@@ -833,14 +866,107 @@ class InfluenceEngine:
 
             gran = math.gcd(s_pad, self.flat_chunk) * self.mesh.shape["data"]
             s_pad = -(-s_pad // gran) * gran
-        tx = jnp.asarray(test_points, jnp.int32)
+        return s_pad
+
+    def flat_geometry(self, test_points: np.ndarray) -> tuple[int, int]:
+        """``(t_pad, s_pad)`` compile geometry of the flat dispatch these
+        points would issue — what :meth:`precompile_flat` must arm so
+        the dispatch itself never traces or compiles."""
+        test_points = np.asarray(test_points)
+        if test_points.ndim == 1:
+            test_points = test_points[None, :]
+        counts = self.index.counts_batch(test_points)
+        return (
+            self._query_pad(int(test_points.shape[0])),
+            self._s_pad_for(int(counts.sum())),
+        )
+
+    def _donate_scratch(self) -> bool:
+        # CPU ignores donation (with a warning per dispatch); meshes
+        # keep the undonated path so global-array layouts stay exactly
+        # as the multi-host assembly expects.
+        return jax.default_backend() != "cpu" and self.mesh is None
+
+    def _aot_key(self, t_pad: int, s_pad: int):
+        return ("flat", t_pad, s_pad, self._rowfeat is not None,
+                self._donate_scratch())
+
+    def precompile_flat(self, geometries) -> dict:
+        """AOT pre-lower + compile flat programs for ``(t_pad, s_pad)``
+        geometries (``jax.jit(...).lower(...).compile()``) ahead of any
+        dispatch, so a warmed engine never pays trace-or-compile on the
+        hot path. Geometries come from :meth:`flat_geometry` over the
+        planned batches (serve warmup) or an explicit list. No-op when
+        the flat path is ineligible. Returns the compile inventory:
+        ``{"compiled": [[t,s],...], "cached": [...], "seconds": float}``.
+        """
+        if not (self.impl in ("auto", "flat") and self._flat_eligible()):
+            return {"compiled": [], "cached": [], "seconds": 0.0}
+        t0 = time.perf_counter()
+        compiled, cached = [], []
+        for t_pad, s_pad in geometries:
+            t_pad, s_pad = int(t_pad), int(s_pad)
+            key = self._aot_key(t_pad, s_pad)
+            if key in self._aot:
+                cached.append([t_pad, s_pad])
+                continue
+            fn = self._flat_fn(s_pad, donate=self._donate_scratch())
+            tx = jax.ShapeDtypeStruct((t_pad, 2), jnp.int32)
+            self._aot[key] = fn.lower(
+                self.params, self.train_x, self.train_y, self._postings,
+                tx, self._rowfeat,
+            ).compile()
+            compiled.append([t_pad, s_pad])
+        return {"compiled": compiled, "cached": cached,
+                "seconds": time.perf_counter() - t0}
+
+    def compiled_geometries(self) -> dict:
+        """Compiled flat-program inventory (bench/serve reporting):
+        AOT ``[t_pad, s_pad]`` pairs plus jit cache keys."""
+        return {
+            "aot": sorted([k[1], k[2]] for k in self._aot),
+            "jit": sorted(str(k) for k in self._jitted),
+        }
+
+    def _flat_exec(self, t_pad: int, s_pad: int):
+        """The executable for one dispatch geometry: the AOT program
+        when :meth:`precompile_flat` armed one, else the jit-cached
+        program (which compiles on first call)."""
+        exe = self._aot.get(self._aot_key(t_pad, s_pad))
+        if exe is not None:
+            return exe
+        return self._flat_fn(s_pad, donate=self._donate_scratch())
+
+    def _dispatch_flat(self, test_points: np.ndarray, pad_to: int | None):
+        """Enqueue one flat query program; returns an opaque handle for
+        :meth:`_finalize_flat`. Dispatch is async — the device starts
+        crunching while the host moves on."""
+        inject.fire(sites.ENGINE_DISPATCH_FLAT)
+        counts = self.index.counts_batch(test_points)
+        total = int(counts.sum())
+        s_pad = self._s_pad_for(total)
+        tx_np = np.ascontiguousarray(np.asarray(test_points, np.int64))
+        T = tx_np.shape[0]
+        t_pad = self._query_pad(T)
+        if t_pad > T:
+            # Query-axis padding: duplicate the trailing (u, i) pair up
+            # to the bucket. Pad rows take flat positions AFTER the real
+            # total (their segment offsets start at off[T]), so real
+            # scores are untouched and _assemble_packed's [:T] slice
+            # recovers bit-identical payloads; pad rows past s_pad are
+            # simply truncated (their garbage Hessians stay PD via the
+            # damping diagonal and are sliced away with everything else).
+            tx_np = np.concatenate(
+                [tx_np, np.repeat(tx_np[-1:], t_pad - T, axis=0)]
+            )
+        tx = jnp.asarray(tx_np, jnp.int32)
         if self._multihost:
             # cross-process jit operands must be global arrays; every
             # process holds the same query batch (replicated input)
             from fia_tpu.parallel.distributed import put_global
 
             tx = put_global(self.mesh, tx, P())
-        out = self._flat_fn(s_pad)(
+        out = self._flat_exec(t_pad, s_pad)(
             self.params, self.train_x, self.train_y, self._postings, tx,
             self._rowfeat,
         )
@@ -1062,6 +1188,10 @@ class InfluenceEngine:
             "solver": self.solver,
             "damping": repr(self.damping),
             "pad_bucket": self.pad_bucket,
+            # part of the numeric identity: the query-axis pad sets the
+            # batched-solve geometry, so results journaled under one
+            # bucket must not resume a run under another
+            "query_bucket": self.query_bucket,
             "batch_queries": int(batch_queries),
             "pad_to": None if pad_to is None else int(pad_to),
             "n_points": int(tp.shape[0]) if tp.ndim > 1 else 1,
@@ -1126,6 +1256,12 @@ class InfluenceEngine:
             )
         else:
             packed, ihvp, v = jax.device_get(out)
+        # Query-axis pad rows (duplicated trailing queries appended by
+        # _dispatch_flat) slice away here; their flat rows already sit
+        # past `total` in the packed scores.
+        T = int(np.asarray(counts).shape[0])
+        ihvp = np.asarray(ihvp)[:T]
+        v = np.asarray(v)[:T]
         # NaN injection site: a diverged solve returns a "successful"
         # buffer — corruption (and detection) happens on the fetched
         # host payload, exactly like the real failure mode.
@@ -1223,6 +1359,7 @@ class InfluenceEngine:
             )
             self.solver = nxt
             self._jitted.clear()
+            self._aot.clear()  # the solver is baked into AOT programs
             res = recompute()
         return res
 

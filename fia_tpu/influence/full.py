@@ -18,6 +18,7 @@ TPU-native choices:
 
 from __future__ import annotations
 
+import time
 from functools import partial
 
 import jax
@@ -134,6 +135,11 @@ class FullInfluenceEngine:
                 # each chunk's row axis is sharded across 'data'
                 b = -(-b // mesh.shape["data"]) * mesh.shape["data"]
             self.hvp_batch = b
+        # AOT-compiled query-path executables, armed by precompile():
+        # keyed by (program name, call geometry); call sites consult
+        # this before the method-level jits so a warmed engine pays no
+        # trace-or-compile on its first real query.
+        self._aot = {}
 
     # -- core pieces -------------------------------------------------------
     # The jitted entry points take flat0/train tensors as ARGUMENTS, not
@@ -229,9 +235,12 @@ class FullInfluenceEngine:
         test data as arguments; jit rather than eager grad because
         multi-process global params only support compiled SPMD programs.
         """
-        return self._test_loss_grad_jit(
-            self._flat0, np.asarray(test_x), np.asarray(test_y)
-        )
+        tx = jnp.asarray(np.asarray(test_x))
+        ty = jnp.asarray(np.asarray(test_y))
+        exe = self._aot.get(("test_loss_grad", tuple(tx.shape)))
+        if exe is not None:
+            return exe(self._flat0, tx, ty)
+        return self._test_loss_grad_jit(self._flat0, tx, ty)
 
     @partial(jax.jit, static_argnums=(0, 6))
     def _solve(self, v, seed, flat0, train_x, train_y, solver):
@@ -275,8 +284,13 @@ class FullInfluenceEngine:
         v = jnp.asarray(v)
         solver = self.solver
         while True:
-            x = self._solve(v, np.uint32(seed), self._flat0,
-                            self.train_x, self.train_y, solver)
+            exe = self._aot.get(("solve", solver))
+            if exe is not None:
+                x = exe(v, np.uint32(seed), self._flat0,
+                        self.train_x, self.train_y)
+            else:
+                x = self._solve(v, np.uint32(seed), self._flat0,
+                                self.train_x, self.train_y, solver)
             # fault-injection site: corrupts the *screened* host copy,
             # so recovery runs exactly as for a real diverged solve
             xh = inject.corrupt(sites.FULL_SOLVE, np.asarray(self._fetch(x)))
@@ -373,13 +387,18 @@ class FullInfluenceEngine:
         return np.asarray(arr)
 
     # -- public API --------------------------------------------------------
+    def _score_all_run(self, u):
+        """_score_all through the AOT executable when armed."""
+        exe = self._aot.get(("score_all",))
+        if exe is not None:
+            return exe(u, self._flat0, self.train_x, self.train_y)
+        return self._score_all(u, self._flat0, self.train_x, self.train_y)
+
     def get_influence_on_test_loss(self, test_x, test_y, seed: int = 0):
         """Predicted test-LOSS change per removed train row, (N,)."""
         v = self.test_loss_grad(test_x, test_y)
         ihvp = self.get_inverse_hvp(v, seed=seed)
-        return self._fetch(
-            self._score_all(ihvp, self._flat0, self.train_x, self.train_y)
-        )
+        return self._fetch(self._score_all_run(ihvp))
 
     @partial(jax.jit, static_argnums=0)
     def _pred_grad_jit(self, flat0, tx):
@@ -398,11 +417,59 @@ class FullInfluenceEngine:
         ‖Hx − v‖/‖v‖ (one extra chunked HVP) — the quality statement
         truncated stress solves must carry.
         """
-        v = self._pred_grad_jit(self._flat0, np.asarray(test_x))
+        tx = jnp.asarray(np.asarray(test_x))
+        exe = self._aot.get(("pred_grad", tuple(tx.shape)))
+        if exe is not None:
+            v = exe(self._flat0, tx)
+        else:
+            v = self._pred_grad_jit(self._flat0, tx)
         ihvp = self.get_inverse_hvp(v, seed=seed)
-        scores = self._fetch(
-            self._score_all(ihvp, self._flat0, self.train_x, self.train_y)
-        )
+        scores = self._fetch(self._score_all_run(ihvp))
         if return_residual:
             return scores, self.relative_residual(v, ihvp)
         return scores
+
+    def precompile(self, n_test: int = 1) -> dict:
+        """AOT pre-lower + compile the query-path programs
+        (``jax.jit(...).lower(...).compile()``) for ``n_test``-row test
+        batches, so a warmed engine's first query pays no
+        trace-or-compile: the test/prediction gradient, the iHVP solve
+        at the current solver rung, and the all-rows scoring jvp. Mesh
+        engines are left on the jit path (their global-array lowering
+        is exercised end-to-end by the distributed tests; AOT there
+        buys nothing — one process compiles either way).
+
+        Returns ``{"compiled": [names], "cached": [names], "seconds"}``.
+        """
+        if self.mesh is not None:
+            return {"compiled": [], "cached": [], "seconds": 0.0}
+        t0 = time.perf_counter()
+        cls = type(self)
+        flat = self._flat0
+        v = jax.ShapeDtypeStruct(flat.shape, flat.dtype)
+        tx = jax.ShapeDtypeStruct(
+            (n_test,) + tuple(self.train_x.shape[1:]), self.train_x.dtype
+        )
+        ty = jax.ShapeDtypeStruct((n_test,), self.train_y.dtype)
+        jobs = {
+            ("test_loss_grad", tuple(tx.shape)): lambda: cls
+            ._test_loss_grad_jit.lower(self, flat, tx, ty),
+            ("pred_grad", tuple(tx.shape)): lambda: cls
+            ._pred_grad_jit.lower(self, flat, tx),
+            ("solve", self.solver): lambda: cls._solve.lower(
+                self, v, np.uint32(0), flat, self.train_x, self.train_y,
+                self.solver,
+            ),
+            ("score_all",): lambda: cls._score_all.lower(
+                self, v, flat, self.train_x, self.train_y
+            ),
+        }
+        compiled, cached = [], []
+        for key, build in jobs.items():
+            if key in self._aot:
+                cached.append(key[0])
+                continue
+            self._aot[key] = build().compile()
+            compiled.append(key[0])
+        return {"compiled": compiled, "cached": cached,
+                "seconds": time.perf_counter() - t0}

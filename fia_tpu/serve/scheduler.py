@@ -50,6 +50,17 @@ class MicroBatcher:
         )
         return np.argsort(buckets, kind="stable")
 
+    def planned_shapes(self, counts: np.ndarray) -> list[tuple[int, int]]:
+        """``(n_queries, total_related_rows)`` per planned batch — the
+        pure packing preview warmup/bench reports use to show what the
+        mega-batch coalescing produced without touching the engine
+        (the engine's ``flat_geometry`` turns these into compile
+        geometries by applying its query/row buckets)."""
+        counts = np.asarray(counts)
+        return [
+            (len(b), int(counts[b].sum())) for b in self.plan(counts)
+        ]
+
     def plan(self, counts: np.ndarray) -> list[np.ndarray]:
         """Batches of queue positions: the coalesced order chunked into
         consecutive ``max_batch`` slices.

@@ -9,10 +9,14 @@ already async under the hood):
 2. :meth:`drain` resolves every queued ticket: expired deadlines are
    rejected; hot-cache and verified disk-tier hits answer without
    device work; the misses are de-duplicated, micro-batched by the
-   scheduler, and dispatched through ``engine.query_batch`` — one
-   compiled program per batch instead of one per query. Results fill
-   both cache tiers, then every ticket resolves from the hot tier (a
-   key repeated within one drain computes once and hits for the rest).
+   scheduler, and dispatched — one compiled mega-batch program per
+   batch instead of one per query. On the single-device flat path up
+   to ``dispatch_window`` programs stay in flight (dispatch of batch
+   N+1 overlaps result assembly of batch N — docs/design.md §14);
+   everywhere else batches go through ``engine.query_batch``
+   sequentially. Results fill both cache tiers, then every ticket
+   resolves from the hot tier (a key repeated within one drain
+   computes once and hits for the rest).
 3. A classified device/deadline failure during a batch dispatch rejects
    exactly that batch's requests with the taxonomy kind as the reason
    and the loop continues — overload and faults shed load
@@ -54,8 +58,13 @@ from fia_tpu.serve.scheduler import MicroBatcher
 class ServeConfig:
     """Service knobs (see module docstrings for the semantics)."""
 
-    max_batch: int = 32  # micro-batch coalescing cap
-    max_queue: int = 256  # admission: tickets allowed to wait
+    # Mega-batch coalescing cap: BENCH_r05 device_split measured the
+    # dispatch wall (~95 of 133 ms per program is host overhead, and
+    # 1024-query dispatches score ~2x the 256-query row), so the
+    # default packs as many queued queries as fit into one fused
+    # dispatch; latency-sensitive deployments dial it back down.
+    max_batch: int = 1024
+    max_queue: int = 4096  # admission: tickets allowed to wait
     coalesce: str = "bucket"  # "bucket" | "fifo" dispatch order
     default_deadline_s: float | None = None  # per-request budget
     cache_entries: int = 1024  # hot-block LRU capacity
@@ -63,6 +72,12 @@ class ServeConfig:
     disk_cache: bool = True  # use cache_dir tier when engine has one
     include_related: bool = True  # attach related train-row ids
     metrics_path: str | None = None  # JSONL events (None = in-memory)
+    # Overlapped dispatch: up to this many flat programs in flight per
+    # drain, so host-side result assembly of batch N overlaps device
+    # execution of batch N+1 (engine dispatch is async). 1 = the
+    # sequential guarded path; >1 applies only where the engine's flat
+    # path is eligible on a single device.
+    dispatch_window: int = 2
 
 
 class InfluenceService:
@@ -224,61 +239,175 @@ class InfluenceService:
             self.metrics.record_request(r)
         return out
 
+    def _overlap_eligible(self, eng) -> bool:
+        """Windowed dispatch applies only where query_batch would run
+        one single-device flat dispatch per batch anyway — so the
+        overlapped stream is dispatch-for-dispatch the program sequence
+        the byte-identity contract pins."""
+        return (
+            int(self.config.dispatch_window) > 1
+            and eng.impl in ("auto", "flat")
+            and eng._flat_eligible()
+            and not eng._wide_block_cap()
+            and eng.mesh is None
+        )
+
     def _dispatch_misses(self, eng, fp, misses, responses) -> None:
         keys = list(misses.keys())  # first-arrival order (dict insertion)
         points = np.asarray([[k[2], k[3]] for k in keys], np.int64)
         counts = eng.index.counts_batch(points)
-        for batch in self.batcher.plan(counts):
-            bid = self._batch_id
-            self._batch_id += 1
-            bpts = points[batch]
-            self.dispatch_log.append((bid, np.array(bpts)))
-            t0 = self.clock()
+        plan = self.batcher.plan(counts)
+        if not self._overlap_eligible(eng):
+            for batch in plan:
+                self._dispatch_one(eng, fp, misses, responses, keys,
+                                   counts, points, batch)
+            return
+        # Overlapped mega-batch dispatch: keep up to dispatch_window
+        # flat programs in flight; finalize strictly in dispatch order.
+        # The SERVE_DISPATCH fire stays host-side immediately before
+        # each batch's dispatch, so a classified fault there (injected
+        # or real) sheds exactly that batch and the stream continues —
+        # the same shed contract as the sequential path.
+        window = int(self.config.dispatch_window)
+        inflight: list = []  # (batch, bid, t0, handle) in dispatch order
+        bi = 0
+        while bi < len(plan) or inflight:
+            while bi < len(plan) and len(inflight) < window:
+                batch = plan[bi]
+                bi += 1
+                bid = self._batch_id
+                self._batch_id += 1
+                bpts = points[batch]
+                self.dispatch_log.append((bid, np.array(bpts)))
+                t0 = self.clock()
+                try:
+                    inject.fire(sites.SERVE_DISPATCH)
+                except Exception as e:
+                    kind = taxonomy.classify(e)
+                    if kind is None:
+                        raise
+                    self._shed_batch(misses, responses, keys, counts,
+                                     batch, bid, kind, t0)
+                    continue
+                try:
+                    h = eng._dispatch_flat(bpts, None)
+                except Exception as e:
+                    if taxonomy.classify(e) is None:
+                        raise
+                    # A real dispatch-time device fault poisons the
+                    # in-flight handles too. Nothing sheds here: reroute
+                    # this batch, the in-flight ones, and the remainder
+                    # through the guarded sequential path — the
+                    # engine-side ladder (reset → retry → halve → CPU
+                    # rung) absorbs what it can, exactly as the
+                    # non-overlapped path would have.
+                    retry = [(b, b_bid) for (b, b_bid, _, _) in inflight]
+                    retry += [(batch, bid)]
+                    retry += [(b, None) for b in plan[bi:]]
+                    inflight.clear()
+                    for b, b_bid in retry:
+                        self._dispatch_one(eng, fp, misses, responses,
+                                           keys, counts, points, b,
+                                           bid=b_bid)
+                    return
+                inflight.append((batch, bid, t0, h))
+            if not inflight:
+                continue
+            batch, bid, t0, h = inflight.pop(0)
             try:
-                inject.fire(sites.SERVE_DISPATCH)
-                res = eng.query_batch(bpts)
+                res = eng._finalize_flat(h)
+                # same NaN screen query_batch applies: a non-finite
+                # payload walks the solver degradation ladder
+                res = eng._nan_ladder(
+                    res, lambda b=points[batch]: eng._query_batch_impl(b)
+                )
             except Exception as e:
                 kind = taxonomy.classify(e)
                 if kind is None:
                     raise
-                dt = self.clock() - t0
-                self.metrics.record_batch(
-                    bid, len(batch), int(counts[batch].sum()), dt,
-                    status=kind,
+                self._shed_batch(misses, responses, keys, counts, batch,
+                                 bid, kind, t0)
+                # A classified finalize fault (worker crash, preemption)
+                # killed every in-flight buffer with it. Shed ONLY the
+                # faulted batch; drop the dead handles and re-dispatch
+                # their batches — plus the unplanned remainder — through
+                # the guarded sequential path, whose engine-side ladder
+                # (reset → retry → halve → CPU rung) owns the recovery.
+                retry = [(b, b_bid) for (b, b_bid, _, _) in inflight]
+                retry += [(b, None) for b in plan[bi:]]
+                inflight.clear()
+                for b, b_bid in retry:
+                    self._dispatch_one(eng, fp, misses, responses, keys,
+                                       counts, points, b, bid=b_bid)
+                return
+            self._bank_batch(eng, fp, misses, responses, keys, counts,
+                             batch, bid, res, t0)
+
+    def _dispatch_one(self, eng, fp, misses, responses, keys, counts,
+                      points, batch, bid=None) -> None:
+        """One guarded sequential dispatch (the non-overlapped serve
+        path, and the degradation rung after a classified fault in the
+        overlapped loop). ``bid`` reuses a batch id the windowed loop
+        already allocated and logged for this batch."""
+        if bid is None:
+            bid = self._batch_id
+            self._batch_id += 1
+            self.dispatch_log.append((bid, np.array(points[batch])))
+        t0 = self.clock()
+        try:
+            inject.fire(sites.SERVE_DISPATCH)
+            res = eng.query_batch(points[batch])
+        except Exception as e:
+            kind = taxonomy.classify(e)
+            if kind is None:
+                raise
+            self._shed_batch(misses, responses, keys, counts, batch, bid,
+                             kind, t0)
+            return
+        self._bank_batch(eng, fp, misses, responses, keys, counts, batch,
+                         bid, res, t0)
+
+    def _shed_batch(self, misses, responses, keys, counts, batch, bid,
+                    kind, t0) -> None:
+        dt = self.clock() - t0
+        self.metrics.record_batch(
+            bid, len(batch), int(counts[batch].sum()), dt, status=kind
+        )
+        for j in batch:
+            for pos, t in misses[keys[int(j)]]:
+                responses[pos] = self._reject(
+                    t, kind, self.clock(), batch_id=bid,
+                    batch_size=len(batch),
                 )
-                for j in batch:
-                    for pos, t in misses[keys[int(j)]]:
-                        responses[pos] = self._reject(
-                            t, kind, self.clock(), batch_id=bid,
-                            batch_size=len(batch),
-                        )
-                continue
-            dt = self.clock() - t0
-            self.metrics.record_batch(
-                bid, len(batch), int(counts[batch].sum()), dt
+
+    def _bank_batch(self, eng, fp, misses, responses, keys, counts, batch,
+                    bid, res, t0) -> None:
+        dt = self.clock() - t0
+        self.metrics.record_batch(
+            bid, len(batch), int(counts[batch].sum()), dt
+        )
+        now = self.clock()
+        for row, j in enumerate(batch):
+            key = keys[int(j)]
+            entry = BlockEntry(
+                scores=np.array(res.scores_of(row)),
+                ihvp=np.array(res.ihvp[row]),
+                test_grad=np.array(res.test_grad[row]),
+                count=int(res.counts[row]),
             )
-            now = self.clock()
-            for row, j in enumerate(batch):
-                key = keys[int(j)]
-                entry = BlockEntry(
-                    scores=np.array(res.scores_of(row)),
-                    ihvp=np.array(res.ihvp[row]),
-                    test_grad=np.array(res.test_grad[row]),
-                    count=int(res.counts[row]),
+            self.cache.put(key, entry)
+            self._disk_put(eng, fp, key, entry)
+            waiting = misses[key]
+            for rank, (pos, t) in enumerate(waiting):
+                # first waiter per key pays the compute; duplicates
+                # coalesced into the same drain are hot-tier hits
+                tier = TIER_COMPUTE if rank == 0 else TIER_HOT
+                if rank > 0:
+                    self.cache.stats.hits_hot += 1
+                responses[pos] = self._respond(
+                    t, entry, tier, now, eng, solve_s=dt,
+                    batch_id=bid, batch_size=len(batch),
                 )
-                self.cache.put(key, entry)
-                self._disk_put(eng, fp, key, entry)
-                waiting = misses[key]
-                for rank, (pos, t) in enumerate(waiting):
-                    # first waiter per key pays the compute; duplicates
-                    # coalesced into the same drain are hot-tier hits
-                    tier = TIER_COMPUTE if rank == 0 else TIER_HOT
-                    if rank > 0:
-                        self.cache.stats.hits_hot += 1
-                    responses[pos] = self._respond(
-                        t, entry, tier, now, eng, solve_s=dt,
-                        batch_id=bid, batch_size=len(batch),
-                    )
 
     # -- response/tier helpers --------------------------------------------
     def _respond(self, t: Ticket, entry: BlockEntry, tier: str, now: float,
@@ -373,17 +502,25 @@ class InfluenceService:
 
     # -- warmup ------------------------------------------------------------
     def warmup(self, points: np.ndarray, fill_cache: bool = False) -> dict:
-        """Precompile the bucket ladder by dispatching the batches the
-        scheduler would plan for ``points``.
+        """Arm the serving dispatch path for ``points``' planned batches.
 
-        Dispatching real batches (rather than AOT-lowering shapes) is
-        deliberate: it exercises the exact jit caches serving hits —
-        per (T, pad-bucket) program shape — and warms the backend's
-        autotuning state. ``fill_cache=True`` additionally banks the
-        warmup results in the hot/disk tiers (useful when ``points``
-        are the expected hot set, not synthetic).
+        Two stages. First, every planned batch's flat dispatch geometry
+        is AOT pre-lowered and compiled (``engine.precompile_flat`` —
+        ``jax.jit(...).lower(...).compile()``), so steady-state serving
+        never traces or compiles on the hot path. Second, the planned
+        batches are actually dispatched: that warms the backend's
+        autotuning state, exercises the exact program the stream will
+        hit, and covers the non-flat engines AOT skips (their jit
+        caches fill per dispatched shape). ``fill_cache=True``
+        additionally banks the warmup results in the hot/disk tiers
+        (useful when ``points`` are the expected hot set, not
+        synthetic).
 
-        Returns {"batches", "compiled_keys", "seconds"}.
+        Returns {"batches", "compiled_keys", "seconds",
+        "planned_geometries", "aot", "all_planned_compiled"} — smoke
+        runs assert ``all_planned_compiled`` so a warmup that missed a
+        planned bucket fails loudly instead of paying a first-request
+        compile in production.
         """
         eng, fp = self._engine_and_fp()
         points = np.asarray(points)
@@ -392,8 +529,18 @@ class InfluenceService:
         before = set(eng._jitted)
         t0 = time.perf_counter()
         counts = eng.index.counts_batch(points)
+        plan = self.batcher.plan(counts)
+        flat_ok = (
+            eng.impl in ("auto", "flat") and eng._flat_eligible()
+            and not eng._wide_block_cap() and eng.mesh is None
+        )
+        planned = []
+        aot = {"compiled": [], "cached": [], "seconds": 0.0}
+        if flat_ok:
+            planned = [list(eng.flat_geometry(points[b])) for b in plan]
+            aot = eng.precompile_flat(planned)
         nb = 0
-        for batch in self.batcher.plan(counts):
+        for batch in plan:
             bpts = points[batch]
             res = eng.query_batch(bpts)
             nb += 1
@@ -409,10 +556,17 @@ class InfluenceService:
                     )
                     self.cache.put(key, entry)
                     self._disk_put(eng, fp, key, entry)
+        armed = {(k[1], k[2]) for k in getattr(eng, "_aot", {})}
         return {
             "batches": nb,
             "compiled_keys": sorted(
                 str(k) for k in set(eng._jitted) - before
             ),
             "seconds": round(time.perf_counter() - t0, 3),
+            "planned_geometries": planned,
+            "aot": aot,
+            "all_planned_compiled": (
+                all(tuple(g) in armed for g in planned) if flat_ok
+                else True  # jit caches warmed by the real dispatches
+            ),
         }

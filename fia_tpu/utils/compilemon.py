@@ -1,0 +1,54 @@
+"""Process-wide backend-compile counter over ``jax.monitoring``.
+
+The no-recompile steady-state contract (docs/design.md §14) needs an
+observable that cannot lie: engine-side caches (``_jitted``/``_aot``)
+say what *we* stored, not what XLA actually compiled. ``jax.monitoring``
+emits a ``/jax/core/compile/backend_compile_duration`` duration event
+for every real backend compilation, so counting those events proves a
+hot path compiled nothing — tracing-cache hits and AOT executable calls
+emit none.
+
+Usage::
+
+    from fia_tpu.utils import compilemon
+    before = compilemon.count()
+    ... hot path ...
+    assert compilemon.count() == before
+
+The listener registers once per process on first use and is never
+removed: ``jax.monitoring`` only offers a global
+``clear_event_listeners`` (which would drop listeners we don't own),
+and an idle counter callback costs nothing.
+"""
+
+from __future__ import annotations
+
+from jax import monitoring as _monitoring
+
+# The per-backend-compile duration event (jax 0.4.x); one firing ==
+# one XLA compilation, whether reached through jit or AOT .compile().
+BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_counts = {"backend_compile": 0}
+_installed = False
+
+
+def _on_duration(event: str, duration: float, **kwargs) -> None:
+    if event == BACKEND_COMPILE_EVENT:
+        _counts["backend_compile"] += 1
+
+
+def install() -> None:
+    """Idempotently register the counting listener."""
+    global _installed
+    if _installed:
+        return
+    _monitoring.register_event_duration_secs_listener(_on_duration)
+    _installed = True
+
+
+def count() -> int:
+    """Backend compilations observed so far in this process (installs
+    the listener on first call — compiles before that are unseen)."""
+    install()
+    return _counts["backend_compile"]

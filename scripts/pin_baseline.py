@@ -24,8 +24,18 @@ bench.py reads output/pinned_baseline.json and reports vs_baseline
 against the pinned number (stable across chip/tunnel state), plus
 vs_baseline_live from its in-run sample for drift detection.
 
+``--protocol bench`` (r6): when the reference checkout (data +
+committed checkpoint) is not mounted, reproduce bench.py's own
+synthetic-fallback workload in-process instead — the SAME shapes,
+seeds, training config and seed-17 heldout query selection bench.py
+uses when FIA_DATA_DIR is absent — so the pinned denominator and the
+live in-run sample measure the identical workload. The protocol is
+recorded in provenance; a pin and a live sample from different
+protocols is exactly the drift the [0.67, 1.5] alert in bench.py
+exists to catch.
+
 Usage: python scripts/pin_baseline.py [--queries 64] [--reps 5]
-       [--out output/pinned_baseline.json]
+       [--protocol reference|bench] [--out output/pinned_baseline.json]
 """
 
 import argparse
@@ -51,6 +61,12 @@ def main():
         "embed16_maxinf1_wd1e-03_cal2-checkpoint-14999.npz"))
     ap.add_argument("--out", default=os.path.join(
         "output", "pinned_baseline.json"))
+    ap.add_argument("--protocol", choices=["reference", "bench"],
+                    default="reference",
+                    help="'reference': committed ML-1M checkpoint + "
+                         "mounted reference data; 'bench': reproduce "
+                         "bench.py's synthetic-fallback workload "
+                         "in-process (no reference checkout needed)")
     args = ap.parse_args()
 
     import torch
@@ -65,21 +81,54 @@ def main():
     jax.config.update("jax_platforms", "cpu")
 
     from fia_tpu.backends.torch_ref import TorchRefMFEngine
-    from fia_tpu.data.loaders import load_dataset
     from fia_tpu.models import MF
-    from fia_tpu.train import checkpoint
 
-    splits = load_dataset("movielens", args.data_dir)
-    train = splits["train"]
-    model = MF(6040, 3706, 16, 1e-3)
-    template = model.init_params(jax.random.PRNGKey(0))
-    params, _, _ = checkpoint.load(args.checkpoint, template)
-    params = {k: np.asarray(v) for k, v in params.items()}
+    users, items, k, wd = 6040, 3706, 16, 1e-3
+    if args.protocol == "bench":
+        # bench.py's synthetic fallback, shape for shape: zipf stream
+        # seed 0, 15k training steps at lr 1e-3 / batch 3020, queries
+        # from sample_heldout_pairs seed 17 — the exact arrays bench.py
+        # builds when FIA_DATA_DIR is absent, so the pinned torch
+        # denominator times the same model and the same query blocks
+        # the live in-run sample does.
+        from fia_tpu.data.synthetic import (
+            sample_heldout_pairs,
+            synthesize_ratings,
+        )
+        from fia_tpu.train.trainer import Trainer, TrainConfig
 
-    # bench.py's exact query selection (seed 17 over the test split)
-    rng = np.random.default_rng(17)
-    sel = rng.choice(splits["test"].num_examples, 256, replace=False)
-    points = splits["test"].x[sel][: args.queries]
+        rows, steps = 975_460, 15_000
+        print(f"[{time.strftime('%H:%M:%S')}] bench protocol: training "
+              f"{steps} steps on {rows} synthetic rows",
+              file=sys.stderr, flush=True)
+        train = synthesize_ratings(users, items, rows, seed=0)
+        model = MF(users, items, k, wd)
+        tr = Trainer(model, TrainConfig(batch_size=3020, num_steps=steps,
+                                        learning_rate=1e-3))
+        state = tr.fit(tr.init_state(model.init_params(
+            jax.random.PRNGKey(0))), train.x, train.y)
+        params = {kk: np.asarray(v) for kk, v in state.params.items()}
+        points = sample_heldout_pairs(train.x, users, items, 256,
+                                      seed=17)[: args.queries]
+        checkpoint_name = f"in-process bench-protocol train ({steps} steps)"
+        stream = "zipf"
+    else:
+        from fia_tpu.data.loaders import load_dataset
+        from fia_tpu.train import checkpoint
+
+        splits = load_dataset("movielens", args.data_dir)
+        train = splits["train"]
+        model = MF(users, items, k, wd)
+        template = model.init_params(jax.random.PRNGKey(0))
+        params, _, _ = checkpoint.load(args.checkpoint, template)
+        params = {kk: np.asarray(v) for kk, v in params.items()}
+
+        # bench.py's exact query selection (seed 17 over the test split)
+        rng = np.random.default_rng(17)
+        sel = rng.choice(splits["test"].num_examples, 256, replace=False)
+        points = splits["test"].x[sel][: args.queries]
+        checkpoint_name = os.path.basename(args.checkpoint)
+        stream = getattr(train, "synth_tag", "") or "real"
 
     wd, damping = 1e-3, 1e-6
     ref = TorchRefMFEngine(params, train.x, train.y, weight_decay=wd,
@@ -123,10 +172,11 @@ def main():
             "cpu_count": os.cpu_count(),
             "loadavg_before": load_before,
             "loadavg_after": os.getloadavg(),
-            "checkpoint": os.path.basename(args.checkpoint),
-            "stream": getattr(train, "synth_tag", "") or "real",
+            "protocol": args.protocol,
+            "checkpoint": checkpoint_name,
+            "stream": stream,
             "solver": "fmin_ncg avextol 1e-3 maxiter 100",
-            "query_selection": "seed-17 test-split sample, first "
+            "query_selection": "seed-17 sample, first "
                                f"{len(points)} of bench.py's 256",
         },
     }

@@ -1,6 +1,8 @@
 #!/usr/bin/env bash
 # Serving smoke: a 200-query synthetic open-loop stream through
 # fia_tpu.cli.serve on CPU, asserting (in-process, see run_smoke):
+#   - warmup AOT-precompiled every planned dispatch geometry
+#     (run_warmup exits nonzero on a coverage miss)
 #   - every request either succeeded or was rejected WITH a reason
 #   - the hot-block cache absorbed repeats (hits > 0)
 # then a human latency report over the metrics JSONL.
@@ -21,7 +23,7 @@ JAX_PLATFORMS=cpu timeout -k 10 300 python -m fia_tpu.cli.serve \
   --synth_train 2000 --synth_test 100 \
   --model MF --embed_size 4 --num_steps_train 300 \
   --train_dir "$DIR" --metrics "$DIR/serve.jsonl" \
-  --max_batch 16 --smoke_requests 200
+  --max_batch 16 --warmup 48 --smoke_requests 200
 
 python scripts/latency_report.py "$DIR/serve.jsonl"
 echo "serve-smoke PASS"

@@ -178,6 +178,63 @@ class TestTraceHygiene:
         assert res.ok, [f.render() for f in res.findings]
 
 
+class TestDispatchPath:
+    """FIA204: no per-query host→device transfers on the registered
+    dispatch hot path (docs/design.md §14)."""
+
+    _ENGINE = "fia_tpu/influence/engine.py"
+
+    def test_transfer_in_loop_flagged(self, tmp_path):
+        res = _lint(tmp_path, {self._ENGINE: """\
+            import jax
+            import jax.numpy as jnp
+
+            def _dispatch_flat(points):
+                out = []
+                for p in points:
+                    out.append(jax.device_put(p))
+                    out.append(jnp.asarray(p))
+                return out
+        """}, select={"FIA204"})
+        assert [f.rule for f in res.findings] == ["FIA204", "FIA204"]
+        assert "_dispatch_flat" in res.findings[0].message
+
+    def test_hoisted_transfer_and_deferred_closure_clean(self, tmp_path):
+        res = _lint(tmp_path, {self._ENGINE: """\
+            import jax
+            import jax.numpy as jnp
+
+            def _dispatch_flat(points):
+                tx = jnp.asarray(points)  # one transfer per dispatch
+                thunks = []
+                for p in points:
+                    thunks.append(lambda p=p: jnp.asarray(p))  # deferred
+                return tx, thunks
+        """}, select={"FIA204"})
+        assert res.ok, [f.render() for f in res.findings]
+
+    def test_unregistered_function_not_policed(self, tmp_path):
+        res = _lint(tmp_path, {self._ENGINE: """\
+            import jax
+
+            def some_helper(points):
+                for p in points:
+                    jax.device_put(p)
+        """}, select={"FIA204"})
+        assert res.ok
+
+    def test_real_dispatch_path_is_clean(self):
+        """The rule holds on the actual repo: the registered dispatch
+        functions perform no in-loop transfers today, so FIA204 acts
+        as a regression tripwire, not a TODO list."""
+        from fia_tpu.analysis.config import DISPATCH_PATH_FUNCTIONS
+
+        paths = sorted({os.path.join(REPO, p)
+                        for p, _ in DISPATCH_PATH_FUNCTIONS})
+        res = lint_paths(paths, select={"FIA204"}, root=REPO)
+        assert res.ok, [f.render() for f in res.findings]
+
+
 _SITES_FIXTURE = """\
     GOOD = "engine.solve"
     ALL_SITES = frozenset({GOOD})

@@ -1,0 +1,179 @@
+"""The fused mega-batch dispatch path (docs/design.md §14):
+
+- chunking equivalence: ``query_many`` over ANY batch split is
+  bit-identical to one full dispatch — the query axis is padded to the
+  ``query_bucket`` so the batched-LU kernel sees the same geometry no
+  matter how the stream was chunked (including a ragged final batch).
+- AOT pre-lowering: ``precompile_flat`` arms executables that the
+  dispatch path then calls — bit-identical to the jit path, with ZERO
+  backend compilations afterwards.
+- no-recompile steady state: after one warm pass, neither the engine's
+  query paths nor the serving drain loop compile anything, proven by
+  counting real XLA backend-compile events (``utils/compilemon``), not
+  by inspecting our own caches.
+"""
+
+import jax
+import numpy as np
+
+from fia_tpu.data.dataset import RatingDataset
+from fia_tpu.influence.engine import InfluenceEngine
+from fia_tpu.models import MF
+from fia_tpu.serve import InfluenceService, Request, ServeConfig
+from fia_tpu.utils import compilemon
+
+U, I, K = 30, 20, 4
+WD = 1e-2
+DAMP = 1e-3
+
+
+def _setup(seed=0, n=400):
+    rng = np.random.default_rng(seed)
+    x = np.stack(
+        [rng.integers(0, U, n), rng.integers(0, I, n)], axis=1
+    ).astype(np.int32)
+    y = rng.integers(1, 6, n).astype(np.float32)
+    train = RatingDataset(x, y)
+    model = MF(U, I, K, WD)
+    params = model.init_params(jax.random.PRNGKey(seed))
+    return model, params, train
+
+
+def _engine(model, params, train, **kw):
+    kw.setdefault("damping", DAMP)
+    kw.setdefault("solver", "direct")
+    return InfluenceEngine(model, params, train, **kw)
+
+
+def _unique_points(train, n):
+    uniq = np.unique(train.x, axis=0)
+    assert len(uniq) >= n
+    return uniq[:n].astype(np.int64)
+
+
+def _flatten(results):
+    """query_many batches → per-query (scores, ihvp, grad) in stream
+    order."""
+    out = []
+    for res in results:
+        for t in range(len(res.counts)):
+            out.append((np.asarray(res.scores_of(t)),
+                        np.asarray(res.ihvp[t]),
+                        np.asarray(res.test_grad[t])))
+    return out
+
+
+class TestChunkingEquivalence:
+    def test_any_split_bit_identical_to_one_dispatch(self):
+        """The property the serving byte-identity contract rests on:
+        however the stream is chunked — even with a ragged final
+        batch — every query's payload is bit-identical to the single
+        full-width dispatch."""
+        model, params, train = _setup()
+        pts = _unique_points(train, 23)
+        eng = _engine(model, params, train)
+
+        full = _flatten(eng.query_many(pts, batch_queries=len(pts)))
+        for bq in (5, 8, 16, 23):  # 5 and 8 leave ragged finals
+            parts = _flatten(eng.query_many(pts, batch_queries=bq))
+            assert len(parts) == len(full)
+            for t, (got, want) in enumerate(zip(parts, full)):
+                for g, w in zip(got, want):
+                    assert np.array_equal(g, w), (bq, t)
+
+    def test_query_batch_matches_query_many(self):
+        model, params, train = _setup(seed=3)
+        pts = _unique_points(train, 9)
+        eng = _engine(model, params, train)
+        res = eng.query_batch(pts)
+        many = _flatten(eng.query_many(pts, batch_queries=4))
+        for t in range(len(pts)):
+            assert np.array_equal(res.scores_of(t), many[t][0])
+            assert np.array_equal(res.ihvp[t], many[t][1])
+
+
+class TestAotPath:
+    def test_aot_dispatch_bit_identical_to_jit(self):
+        model, params, train = _setup(seed=1)
+        pts = _unique_points(train, 7)
+
+        eng_jit = _engine(model, params, train)
+        want = eng_jit.query_batch(pts)
+
+        eng_aot = _engine(model, params, train)
+        info = eng_aot.precompile_flat([eng_aot.flat_geometry(pts)])
+        assert info["compiled"], "precompile armed nothing"
+        got = eng_aot.query_batch(pts)
+        assert np.array_equal(got._packed, want._packed)
+        assert np.array_equal(got.ihvp, want.ihvp)
+
+    def test_precompiled_dispatch_compiles_nothing(self):
+        """After precompile_flat, the first real dispatch of that
+        geometry runs entirely on the AOT executable: zero backend
+        compilations, zero new jit cache entries for the flat stage."""
+        model, params, train = _setup(seed=2)
+        pts = _unique_points(train, 7)
+        eng = _engine(model, params, train)
+        eng.precompile_flat([eng.flat_geometry(pts)])
+        # absorb eager-op helper compiles (result assembly, nan scan)
+        # once — they are shape-keyed and reused afterwards
+        eng.query_batch(pts)
+        before = compilemon.count()
+        eng.query_batch(pts)
+        assert compilemon.count() == before
+        # the dispatch geometry is resident as an AOT executable
+        # (precompile stores the lowered-from jit wrapper in _jitted
+        # too, but it is never traced-and-compiled a second time —
+        # that's what the counter above proves)
+        assert eng.compiled_geometries()["aot"]
+
+    def test_precompile_is_idempotent_and_reports_cached(self):
+        model, params, train = _setup(seed=4)
+        pts = _unique_points(train, 5)
+        eng = _engine(model, params, train)
+        geom = eng.flat_geometry(pts)
+        first = eng.precompile_flat([geom])
+        again = eng.precompile_flat([geom])
+        assert list(geom) in [list(g) for g in first["compiled"]]
+        assert list(geom) in [list(g) for g in again["cached"]]
+        assert not again["compiled"]
+
+
+class TestNoRecompileSteadyState:
+    def test_engine_steady_state_compiles_nothing(self):
+        """Warm once, then hammer a MIXED-bucket stream through both
+        query entry points: the full 64-query set lands in a larger
+        total-row bucket than its 8-query chunks, so the stream
+        alternates between two compiled geometries — the backend-
+        compile counter still must not move."""
+        model, params, train = _setup(seed=5)
+        pts = _unique_points(train, 64)
+        eng = _engine(model, params, train)
+        big = eng.flat_geometry(pts)
+        small = eng.flat_geometry(pts[:8])
+        assert big[1] > small[1]  # genuinely distinct row buckets
+        eng.precompile_flat([big, small])
+        eng.query_batch(pts)  # warm pass: helper/eager compiles land here
+        eng.query_many(pts, batch_queries=8)
+        before = compilemon.count()
+        eng.query_batch(pts)
+        eng.query_many(pts, batch_queries=8)
+        eng.query_many(pts, batch_queries=16)  # same buckets, new split
+        assert compilemon.count() == before
+
+    def test_serve_steady_state_compiles_nothing(self):
+        """Warmup + one warm stream, then a fresh stream of NEW points
+        with the same batch geometry: the drain loop must dispatch on
+        pre-compiled programs only."""
+        model, params, train = _setup(seed=6)
+        pts = _unique_points(train, 32)
+        eng = _engine(model, params, train)
+        svc = InfluenceService(engine=eng, config=ServeConfig(
+            max_batch=8, disk_cache=False))
+        info = svc.warmup(pts[:16])
+        assert info["all_planned_compiled"]
+        svc.run([Request(int(u), int(i)) for u, i in pts[16:24]])
+        before = compilemon.count()
+        out = svc.run([Request(int(u), int(i)) for u, i in pts[24:32]])
+        assert all(r.ok for r in out)
+        assert compilemon.count() == before

@@ -108,8 +108,14 @@ class TestShardedTables:
                               mesh=mesh, shard_tables=True, impl=impl)
         got = eng.query_batch(pts, pad_to=want.scores.shape[1])
         for t in range(len(pts)):
+            # atol re-pinned 1e-6 → 1e-5 at the r8 flat geometry: the
+            # single-device baseline now pads the query axis to
+            # query_bucket (docs/design.md §14), which selects a
+            # different batched-LU kernel than the mesh engines' T-wide
+            # solve — float32 rounding diverges by ~1e-6 on near-zero
+            # scores while rank agreement stays exact.
             np.testing.assert_allclose(
-                got.scores_of(t), want.scores_of(t), rtol=1e-4, atol=1e-6
+                got.scores_of(t), want.scores_of(t), rtol=1e-4, atol=1e-5
             )
 
     def test_shard_model_params_layout(self):

@@ -102,6 +102,34 @@ class TestByteIdentity:
             assert np.array_equal(r.ihvp, res.ihvp[t])
             assert np.array_equal(r.test_grad, res.test_grad[t])
 
+    def test_admitted_results_match_query_many_at_mega_geometry(self):
+        """The byte-identity contract re-pinned at the r6 default
+        geometry: max_batch 1024 coalesces this whole stream into ONE
+        fused dispatch through the windowed path, and every payload is
+        still bit-identical to query_many over the scheduler's order."""
+        model, params, train = _setup(seed=7)
+        pts = _unique_points(train, 37)
+        eng = _engine(model, params, train)
+        svc = _service(eng)  # default ServeConfig: mega-batch geometry
+        responses = svc.run([Request(int(u), int(i)) for u, i in pts])
+        assert all(r.ok for r in responses)
+        assert len(svc.dispatch_log) == 1  # one fused dispatch
+
+        eng2 = _engine(model, params, train)
+        mb = ServeConfig().max_batch
+        order = MicroBatcher(mb, "bucket",
+                             pad_bucket=eng2.pad_bucket).order(
+            eng2.index.counts_batch(pts)
+        )
+        many = eng2.query_many(pts[order], batch_queries=mb)
+        flat = [(res, t) for res in many for t in range(len(res.counts))]
+        for rank, pos in enumerate(order):
+            res, t = flat[rank]
+            r = responses[pos]
+            assert np.array_equal(r.scores, res.scores_of(t))
+            assert np.array_equal(r.ihvp, res.ihvp[t])
+            assert np.array_equal(r.test_grad, res.test_grad[t])
+
     def test_duplicates_compute_once_and_hit_bit_identical(self):
         model, params, train = _setup()
         u, i = (int(v) for v in _unique_points(train, 1)[0])
