@@ -1,7 +1,7 @@
 # Convenience targets. The commands themselves are pinned in
 # ROADMAP.md (tier-1) and scripts/ — these targets just name them.
 
-.PHONY: tier1 test lint lint-io serve-smoke chaos-smoke chaos-soak
+.PHONY: tier1 test lint lint-io serve-smoke multichip-smoke chaos-smoke chaos-soak
 
 # The ROADMAP.md tier-1 verify: fast CPU suite, slow tests excluded.
 # Lint is fatal — a finding fails the build before pytest runs.
@@ -28,6 +28,13 @@ lint-io:
 # on CPU (<60s) — zero unreasoned drops, hot-cache hits, latency report.
 serve-smoke:
 	bash scripts/serve_smoke.sh
+
+# Multichip smoke: the sharded dispatch path on 8 virtual CPU devices
+# (bench.py multichip --quick) — full 1/2/4/8 device sweep with zero
+# steady-state compiles per row, multi-device serving bit-identical to
+# single-device. docs/design.md §15 has the mesh design.
+multichip-smoke:
+	bash scripts/multichip_smoke.sh
 
 # Chaos smoke: fixed-seed benign fault schedules against the three
 # end-to-end scenarios (train→kill→resume, cached query_many, serve

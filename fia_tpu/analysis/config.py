@@ -55,6 +55,25 @@ DISPATCH_TRANSFER_CALLS = frozenset({
     "jax.numpy.array",
 })
 
+# FIA205: mesh-aware placement discipline on the same dispatch path.
+# An un-sharded ``jax.device_put(x)`` of a batch-axis array in a
+# registered dispatch-path function lands the WHOLE batch on device 0 —
+# under a mesh that serializes every shard's work through one device
+# and silently un-does the query-axis sharding (docs/design.md §15).
+# Per-shard placement must go through the fia_tpu/parallel helpers
+# below (which attach the mesh's NamedSharding, single- and
+# multi-process alike) or pass an explicit placement operand.
+MESH_PLACEMENT_HELPERS = frozenset({
+    "put_global",
+    "shard_along",
+    "replicate",
+})
+# device_put spellings FIA205 inspects for a missing placement operand.
+UNSHARDED_TRANSFER_CALLS = frozenset({
+    "jax.device_put",
+    "device_put",
+})
+
 # FIA302 applies to files whose repo-relative path starts with:
 RELIABILITY_PREFIX = "fia_tpu/reliability/"
 

@@ -1,9 +1,11 @@
-"""FIA201/202/203/204 — trace and dispatch hygiene.
+"""FIA201/202/203/204/205 — trace and dispatch hygiene.
 
-FIA201–203 police jit-traced function bodies; FIA204 polices the
-*host-side* dispatch path (the registered functions that pack a batch
-and launch one fused device program), where the hazard is per-query
-host→device transfers rather than trace-time syncs.
+FIA201–203 police jit-traced function bodies; FIA204 and FIA205 police
+the *host-side* dispatch path (the registered functions that pack a
+batch and launch one fused device program), where the hazards are
+per-query host→device transfers (204) and un-sharded placement that
+lands a batch-axis array on device 0 under a mesh (205) rather than
+trace-time syncs.
 
 The serving path's latency contract rests on the pad-bucket discipline:
 every hot dispatch reuses a compiled program. The three ways that
@@ -270,6 +272,20 @@ class ClosureCaptureRule(FileRule):
         return findings
 
 
+def _body_calls(fn: ast.FunctionDef):
+    """Calls lexically inside ``fn``, skipping nested defs/lambdas (the
+    same deferred-code carve-out as :func:`_loop_body_calls`)."""
+    def rec(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            if isinstance(child, ast.Call):
+                yield child
+            yield from rec(child)
+    yield from rec(fn)
+
+
 def _loop_body_calls(fn: ast.FunctionDef):
     """Calls lexically inside a loop body of ``fn``, skipping nested
     defs/lambdas: a closure built in a loop is deferred code (the
@@ -319,4 +335,46 @@ class DispatchTransferRule(FileRule):
                         "hoist it above the loop or pack the batch "
                         "first",
                     ))
+        return findings
+
+
+@register
+class UnshardedTransferRule(FileRule):
+    """Un-sharded ``jax.device_put`` on the registered dispatch path."""
+
+    id = "FIA205"
+    name = "unsharded-transfer-in-dispatch"
+
+    def check(self, sf: SourceFile):
+        wanted = {
+            name for path, name in config.DISPATCH_PATH_FUNCTIONS
+            if sf.rel.endswith(path)
+        }
+        if not wanted:
+            return []
+        findings: list[Finding] = []
+        for node in ast.walk(sf.tree):
+            if not (isinstance(node, ast.FunctionDef)
+                    and node.name in wanted):
+                continue
+            for call in _body_calls(node):
+                cn = call_name(call)
+                if cn not in config.UNSHARDED_TRANSFER_CALLS:
+                    continue
+                placed = len(call.args) >= 2 or any(
+                    kw.arg in ("device", "sharding", "src")
+                    for kw in call.keywords
+                )
+                if placed:
+                    continue
+                helpers = "/".join(sorted(config.MESH_PLACEMENT_HELPERS))
+                findings.append(Finding(
+                    self.id, sf.rel, call.lineno, call.col_offset,
+                    f"un-sharded {cn}() in dispatch-path function "
+                    f"{node.name!r} lands the whole batch on device 0 — "
+                    "under a mesh this serializes every shard through "
+                    "one device (docs/design.md §15); route placement "
+                    f"through fia_tpu/parallel ({helpers}) or pass an "
+                    "explicit sharding operand",
+                ))
         return findings

@@ -106,11 +106,15 @@ class FIAModel:
         key = (name, tuple(sorted(extra.items())))
         eng = self._engines.get(key)
         if eng is None:
+            # an explicit mesh in extra (e.g. ServeConfig.mesh through
+            # from_model) overrides the model-level one; key was built
+            # before the pop, so engines on different meshes coexist
+            mesh = extra.pop("mesh", self.mesh)
             eng = self._engines[key] = InfluenceEngine(
                 self.model, self.state.params, self.data_sets["train"],
                 damping=self.damping, solver=name,
                 cache_dir=self.train_dir, model_name=self.model_name,
-                mesh=self.mesh, **extra,
+                mesh=mesh, **extra,
             )
         return eng
 

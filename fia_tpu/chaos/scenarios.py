@@ -368,6 +368,7 @@ class ServeStreamScenario(Scenario):
 
     name = "serve_stream"
     MAX_BATCH, MAX_QUEUE, WAVE = 3, 6, 9
+    mesh = None  # serve_stream_mesh shards dispatch over a device mesh
     # 4 guaranteed micro-batch dispatches; 10 disk-tier publishes on a
     # shed-free run (benign damage never sheds), but only the first
     # publish is guaranteed once full-domain dispatch faults can shed
@@ -391,9 +392,10 @@ class ServeStreamScenario(Scenario):
 
         x, y = _toy_data(0, 400)
         self.model = MF(_U, _I, _K, _WD)
-        params = self.model.init_params(jax.random.PRNGKey(0))
+        self.params = self.model.init_params(jax.random.PRNGKey(0))
+        self.train_ds = RatingDataset(x, y)
         self.engine = InfluenceEngine(
-            self.model, params, RatingDataset(x, y), damping=_DAMP,
+            self.model, self.params, self.train_ds, damping=_DAMP,
             model_name="chaos-serve")
         # 12 distinct keys; the stream below replays some of them
         rng = np.random.default_rng(2)
@@ -418,7 +420,8 @@ class ServeStreamScenario(Scenario):
         svc = InfluenceService(
             engine=eng,
             config=ServeConfig(max_batch=self.MAX_BATCH,
-                               max_queue=self.MAX_QUEUE),
+                               max_queue=self.MAX_QUEUE,
+                               mesh=self.mesh),
             clock=rpolicy.VirtualClock(),
         )
         from fia_tpu.serve.request import Request
@@ -482,6 +485,92 @@ class ServeStreamScenario(Scenario):
         return failures
 
 
+class ServeStreamMeshScenario(ServeStreamScenario):
+    """The serve_stream workload with dispatch sharded over a 2-device
+    ``data`` mesh (query-axis sharding, docs/design.md §15).
+
+    Same request stream, admission bounds, and fault domain as
+    ``serve_stream`` — a dispatch fault on a sharded micro-batch sheds
+    exactly that batch, and admission stays a pure function of the
+    submit stream. The mesh-specific oracle: every score actually
+    served, in the golden run AND under faults, must be BIT-identical
+    to a single-device reference stream computed fault-free at
+    construction — sharding must never show through in results.
+    Degrades to the single-device workload (with a ``mesh_skipped``
+    event) when fewer than 2 devices exist, so the scenario stays
+    runnable on any host.
+    """
+
+    name = "serve_stream_mesh"
+    NDEV = 2
+
+    def __init__(self):
+        super().__init__()
+        import jax
+
+        from fia_tpu.influence.engine import InfluenceEngine
+        from fia_tpu.parallel.mesh import make_mesh
+        from fia_tpu.serve.request import Request
+        from fia_tpu.serve.service import InfluenceService, ServeConfig
+
+        # single-device reference stream, computed fault-free before any
+        # schedule is armed (no workdir: the disk tier stays off, as it
+        # is for the first dispatch of every chaos run)
+        ref_svc = InfluenceService(
+            engine=self.engine,
+            config=ServeConfig(max_batch=self.MAX_BATCH,
+                               max_queue=self.MAX_QUEUE),
+            clock=rpolicy.VirtualClock(),
+        )
+        reqs = [Request(u, i, id=f"q{n}")
+                for n, (u, i) in enumerate(self._stream())]
+        self.ref = {
+            r.id: np.asarray(r.scores).copy()
+            for r in ref_svc.run(reqs, drain_every=self.WAVE) if r.ok
+        }
+        if jax.device_count() >= self.NDEV:
+            self.mesh = make_mesh(self.NDEV)
+            self.engine = InfluenceEngine(
+                self.model, self.params, self.train_ds, damping=_DAMP,
+                model_name="chaos-serve-mesh", mesh=self.mesh)
+
+    def run(self, workdir: str, events: list) -> dict:
+        if self.mesh is None:
+            import jax
+
+            events.append({"event": "mesh_skipped",
+                           "devices": int(jax.device_count())})
+        return super().run(workdir, events)
+
+    def check(self, golden: dict, record) -> list:
+        from fia_tpu.chaos.oracles import OracleFailure
+
+        failures = super().check(golden, record)
+        outcomes = [("golden", golden)]
+        if record.error is None and record.outcome is not None:
+            outcomes.append(("chaos", record.outcome))
+        for label, out in outcomes:
+            for name, v in out.items():
+                if not name.endswith(":scores"):
+                    continue
+                rid = name[: -len(":scores")]
+                ref = self.ref.get(rid)
+                if ref is None:
+                    failures.append(OracleFailure(
+                        "mesh_single_device_identity",
+                        f"{label} run served {rid}, which the "
+                        "single-device reference rejected",
+                    ))
+                elif not np.array_equal(np.asarray(v), ref):
+                    failures.append(OracleFailure(
+                        "mesh_single_device_identity",
+                        f"{label} run: scores for {rid} diverge from "
+                        "the single-device reference (sharded dispatch "
+                        "must be bit-identical)",
+                    ))
+        return failures
+
+
 def make_scenarios() -> dict:
     """Fresh scenario registry (instances are lazily constructed so the
     selftest path never imports jax)."""
@@ -491,6 +580,7 @@ def make_scenarios() -> dict:
         TrainResumeScenario.name: TrainResumeScenario,
         QueryCacheScenario.name: QueryCacheScenario,
         ServeStreamScenario.name: ServeStreamScenario,
+        ServeStreamMeshScenario.name: ServeStreamMeshScenario,
     }
 
 

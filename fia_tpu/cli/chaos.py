@@ -32,10 +32,13 @@ import tempfile
 from fia_tpu.chaos.runner import ChaosEngine
 from fia_tpu.chaos.scenarios import SCENARIO_NAMES
 
-# The smoke battery: the jax-free selftest plus the three end-to-end
-# scenarios, two benign seeded schedules each.
+# The smoke battery: the jax-free selftest plus the end-to-end
+# scenarios, two benign seeded schedules each. serve_stream_mesh needs
+# multiple devices to exercise sharded dispatch (scripts/chaos_smoke.sh
+# forces 8 virtual CPU devices); on a 1-device host it degrades to the
+# single-device workload rather than failing.
 SMOKE_SCENARIOS = ("selftest", "train_resume", "query_cache",
-                   "serve_stream")
+                   "serve_stream", "serve_stream_mesh")
 SMOKE_SEEDS_PER_SCENARIO = 2
 SMOKE_FAULTS = 3
 

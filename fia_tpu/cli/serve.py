@@ -89,10 +89,11 @@ def build_service(args):
     name = common.model_name_for(args, splits=splits)
     _, state, _ = common.train_or_load(args, model, params, splits,
                                        verbose=False)
+    mesh = common.mesh_for(args)
     engine = InfluenceEngine(
         model, state.params, splits["train"],
         cache_dir=args.train_dir, model_name=name,
-        mesh=common.mesh_for(args), **common.engine_kwargs(args),
+        mesh=mesh, **common.engine_kwargs(args),
     )
     metrics = args.metrics
     if metrics == "none":
@@ -108,6 +109,7 @@ def build_service(args):
         cache_entries=args.cache_entries, coalesce=args.coalesce,
         default_deadline_s=args.request_deadline or None,
         disk_cache=bool(args.disk_cache), metrics_path=metrics,
+        mesh=mesh,
     )
     svc = InfluenceService(engine=engine, config=cfg)
     return svc, splits

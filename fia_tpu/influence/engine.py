@@ -575,6 +575,16 @@ class InfluenceEngine:
         "solve" after the batched solves; "scores" (default) is the
         full program. Stages are cumulative prefixes of one program, so
         best-of-N time differences attribute device cost per stage.
+
+        Under a mesh the SAME single-device body runs per query shard:
+        ``_dispatch_flat`` packs the batch into a ``(ndev, t_loc, 2)``
+        scratch placed along the 'data' axis, and the compiled program
+        is a vmap of this body over the shard axis — embarrassingly
+        parallel, zero hot-path collectives (each query's Hessian only
+        reads its own related rows), and bit-identical to the
+        single-device program because every shard executes the exact
+        accumulation order the single-device geometry would
+        (docs/design.md §15).
         """
         use_feat = self._rowfeat is not None
         key = ("flat", s_pad, stage, use_feat, donate)
@@ -587,31 +597,12 @@ class InfluenceEngine:
         d = model.block_size
         # chunk must divide S; flat_chunk is a power of two and S a
         # multiple of the bucket floor, so the gcd is their largest
-        # common chunking (≥ 2048 whenever flat_chunk ≥ 2048)
+        # common chunking (≥ 2048 whenever flat_chunk ≥ 2048). Under a
+        # mesh s_pad is the PER-SHARD row pad (same bucketing), so the
+        # same gcd applies shard-locally.
         import math
 
-        if mesh is None:
-            chunk = math.gcd(s_pad, self.flat_chunk)
-        else:
-            # _query_flat rounded S up to a device multiple; the chunk
-            # must divide the PER-DEVICE shard, not just S
-            ndev = mesh.shape["data"]
-            # explicit raise, not assert: this is trace-time (cost nil)
-            # and a caller bypassing _dispatch_flat's rounding under
-            # python -O would otherwise get a wrong reshape, not an error
-            if s_pad % ndev != 0:
-                raise ValueError(
-                    f"padded size {s_pad} not divisible by mesh devices "
-                    f"{ndev}; route through _dispatch_flat"
-                )
-            chunk = math.gcd(s_pad // ndev, self.flat_chunk)
-
-            def c(a):  # shard an S-leading array across 'data'
-                return jax.lax.with_sharding_constraint(
-                    a, NamedSharding(
-                        mesh, P("data", *([None] * (a.ndim - 1)))
-                    )
-                )
+        chunk = math.gcd(s_pad, self.flat_chunk)
 
         def fn(params, train_x, train_y, postings, tx, rowfeat):
             T = tx.shape[0]
@@ -653,11 +644,6 @@ class InfluenceEngine:
                 urows.shape[0] + ioff[it] + pos - nu[t],
             )
             row = cat_rows[jnp.clip(base, 0, cat_rows.shape[0] - 1)]
-            if mesh is not None:
-                # shard the flat row axis: the gather, gradient vmap and
-                # Hessian accumulation below all split across devices
-                row, t, pos, valid = (c(a) for a in (row, t, pos, valid))
-                ut, it = c(u[t]), c(i[t])
             wv = valid.astype(jnp.float32)
 
             # Per-flat-row prediction gradients w.r.t. the owning
@@ -756,22 +742,10 @@ class InfluenceEngine:
                 return acc, s_abe
 
             nc = s_pad // chunk
-            if mesh is None:
-                HH, sum_abe = accum(
-                    g.reshape(nc, chunk, d), t.reshape(nc, chunk),
-                    wv.reshape(nc, chunk), (ab * e).reshape(nc, chunk),
-                )
-            else:
-                # per-device partial accumulators (the device axis is the
-                # sharded leading dim, so the vmap is purely local work),
-                # then a sum over it — the one XLA-inserted psum
-                nl = nc // ndev
-                shp = lambda a, *tail: c(a.reshape(ndev, nl, chunk, *tail))
-                HH_p, abe_p = jax.vmap(accum)(
-                    shp(g, d), shp(t), shp(wv), shp(ab * e)
-                )
-                HH = jnp.sum(HH_p, axis=0)
-                sum_abe = jnp.sum(abe_p, axis=0)
+            HH, sum_abe = accum(
+                g.reshape(nc, chunk, d), t.reshape(nc, chunk),
+                wv.reshape(nc, chunk), (ab * e).reshape(nc, chunk),
+            )
             n_t = jnp.maximum(counts.astype(jnp.float32), 1.0)
             C = model.block_cross_const(params)
             rdiag = model.block_reg_diag(params)
@@ -801,31 +775,52 @@ class InfluenceEngine:
             scores = wv * (
                 2.0 * e * jnp.einsum("sd,sd->s", g, ihvp[t]) + reg_dot[t]
             ) / n_t[t]
-            if mesh is not None:
-                # pin output shardings: scores stay flat-axis-sharded,
-                # the per-query solves replicate — so the multi-host
-                # fetch (process_allgather in _assemble_packed) sees a
-                # deterministic layout instead of whatever GSPMD chose
-                rep = NamedSharding(mesh, P())
-                scores = c(scores)
-                ihvp = jax.lax.with_sharding_constraint(ihvp, rep)
-                v = jax.lax.with_sharding_constraint(v, rep)
             return scores, ihvp, v
 
-        # Donating the (T, 2) query ids — the only per-dispatch
-        # host→device operand — lets XLA reuse their buffer instead of
+        if mesh is None:
+            out_fn = fn
+        else:
+            def out_fn(params, train_x, train_y, postings, txs, rowfeat):
+                # (ndev, t_loc, 2) query shards placed along 'data' by
+                # _dispatch_flat: vmap the single-device body over the
+                # shard axis and pin every output's leading dim to the
+                # same placement, so GSPMD partitions the whole program
+                # shard-locally (each device runs exactly the
+                # single-device geometry on its own queries) and the
+                # host fetch sees a deterministic layout.
+                txs = jax.lax.with_sharding_constraint(
+                    txs, NamedSharding(mesh, P("data", None, None))
+                )
+                out = jax.vmap(
+                    lambda t: fn(params, train_x, train_y, postings, t,
+                                 rowfeat)
+                )(txs)
+                return jax.tree_util.tree_map(
+                    lambda a: jax.lax.with_sharding_constraint(
+                        a, NamedSharding(
+                            mesh, P("data", *([None] * (a.ndim - 1)))
+                        )
+                    ),
+                    out,
+                )
+
+        # Donating the query-id scratch — the only per-dispatch
+        # host→device operand — lets XLA reuse its buffer instead of
         # allocating one per dispatch (every other operand is resident).
         self._jitted[key] = (
-            jax.jit(fn, donate_argnums=(4,)) if donate else jax.jit(fn)
+            jax.jit(out_fn, donate_argnums=(4,)) if donate
+            else jax.jit(out_fn)
         )
         return self._jitted[key]
 
     def _flat_eligible(self) -> bool:
         return (
-            # meshes (single- or multi-process) shard the flat axis with
-            # per-device partial Hessians + one psum; multi-host output
-            # assembly rides the same process allgather as the padded
-            # path (r3 VERDICT item 5 — the fast path now covers pods)
+            # meshes (single- or multi-process) shard the QUERY axis:
+            # each device runs the single-device program on its own
+            # query shard (_mesh_plan / _dispatch_flat), so mesh
+            # results stay bit-identical to one device; multi-host
+            # output assembly rides the same process allgather as the
+            # padded path (r3 VERDICT item 5 — the fast path covers pods)
             self.solver == "direct"
             and not self.group_queries
             # the flat path always builds the Hessian from the analytic
@@ -841,11 +836,12 @@ class InfluenceEngine:
     def _query_pad(self, T: int) -> int:
         """Query-axis pad of a flat dispatch (see ``query_bucket``).
 
-        Meshes keep the exact T: the sharded program replicates the
-        query axis and its geometry reuse matters less than leaving the
-        multi-host dispatch layout untouched.
+        Under a mesh this is the PER-SHARD pad: ``_mesh_plan`` calls it
+        on the shard's query count, so every shard solves at the same
+        canonical batch size as a single-device dispatch of that count
+        (the bit-exactness contract of ``query_bucket``).
         """
-        if self.query_bucket <= 0 or self.mesh is not None:
+        if self.query_bucket <= 0:
             return T
         return bucketed_pad(T, self.query_bucket)
 
@@ -857,39 +853,72 @@ class InfluenceEngine:
         256-query batches — the flat program is compute-bound, so
         padding is wall-clock). The power-of-two floor keeps S a
         multiple of every flat_chunk ≤ floor (the scan reshape needs
-        chunk | S).
+        chunk | S). Under a mesh this buckets each shard's OWN row
+        total (``_mesh_plan`` takes the max across shards).
         """
-        s_pad = bucketed_pad(total, 2048)
-        if self.mesh is not None:
-            # the flat axis splits into ndev chunk-aligned shards
-            import math
+        return bucketed_pad(total, 2048)
 
-            gran = math.gcd(s_pad, self.flat_chunk) * self.mesh.shape["data"]
-            s_pad = -(-s_pad // gran) * gran
-        return s_pad
+    def _mesh_plan(self, counts: np.ndarray, T: int):
+        """Query-axis shard plan of one mesh dispatch.
+
+        The batch splits into ``ndev`` contiguous shards of ``q`` real
+        queries (the last possibly ragged or empty); every shard pads
+        its query axis to a common ``t_loc`` and its flat row axis to a
+        common ``s_loc`` — the max over shards of the single-device
+        bucketing — so each device executes exactly the single-device
+        program geometry on its slice. Returns
+        ``(ndev, q, t_loc, s_loc)``.
+        """
+        ndev = int(self.mesh.shape["data"])
+        q = -(-max(int(T), 1) // ndev)
+        t_loc = self._query_pad(q)
+        counts = np.asarray(counts, np.int64)
+        s_loc = 1
+        for k in range(ndev):
+            tot = int(counts[k * q: (k + 1) * q].sum())
+            s_loc = max(s_loc, self._s_pad_for(max(tot, 1)))
+        return ndev, q, t_loc, s_loc
 
     def flat_geometry(self, test_points: np.ndarray) -> tuple[int, int]:
         """``(t_pad, s_pad)`` compile geometry of the flat dispatch these
         points would issue — what :meth:`precompile_flat` must arm so
-        the dispatch itself never traces or compiles."""
+        the dispatch itself never traces or compiles. Under a mesh both
+        numbers are PER-SHARD (the executable's shapes carry a leading
+        ``ndev`` shard axis on top of them)."""
         test_points = np.asarray(test_points)
         if test_points.ndim == 1:
             test_points = test_points[None, :]
         counts = self.index.counts_batch(test_points)
+        if self.mesh is not None:
+            _, _, t_loc, s_loc = self._mesh_plan(
+                counts, int(test_points.shape[0])
+            )
+            return (t_loc, s_loc)
         return (
             self._query_pad(int(test_points.shape[0])),
             self._s_pad_for(int(counts.sum())),
         )
 
     def _donate_scratch(self) -> bool:
-        # CPU ignores donation (with a warning per dispatch); meshes
-        # keep the undonated path so global-array layouts stay exactly
-        # as the multi-host assembly expects.
-        return jax.default_backend() != "cpu" and self.mesh is None
+        # CPU ignores donation (with a warning per dispatch).
+        # Single-process meshes donate since r7: the scratch is placed
+        # with exactly the NamedSharding the executable was lowered
+        # with, so the donated layout always matches (pinned by
+        # tests/test_mesh_dispatch.py). Multi-host keeps the undonated
+        # path — per-process pieces of a make_array_from_callback
+        # global carry no such layout guarantee.
+        return jax.default_backend() != "cpu" and not self._multihost
+
+    def _mesh_fp(self):
+        from fia_tpu.parallel.mesh import mesh_fingerprint
+
+        return mesh_fingerprint(self.mesh)
 
     def _aot_key(self, t_pad: int, s_pad: int):
+        # mesh fingerprint LAST: warmup/compiled_geometries index the
+        # geometry as (k[1], k[2]) — appending keeps those stable
         return ("flat", t_pad, s_pad, self._rowfeat is not None,
-                self._donate_scratch())
+                self._donate_scratch(), self._mesh_fp())
 
     def precompile_flat(self, geometries) -> dict:
         """AOT pre-lower + compile flat programs for ``(t_pad, s_pad)``
@@ -911,7 +940,18 @@ class InfluenceEngine:
                 cached.append([t_pad, s_pad])
                 continue
             fn = self._flat_fn(s_pad, donate=self._donate_scratch())
-            tx = jax.ShapeDtypeStruct((t_pad, 2), jnp.int32)
+            if self.mesh is not None:
+                # lower WITH the dispatch-time input sharding: the AOT
+                # executable is strict about operand placement, and
+                # baking the NamedSharding in keeps steady state
+                # zero-compile on any device count (compilemon-pinned)
+                ndev = int(self.mesh.shape["data"])
+                tx = jax.ShapeDtypeStruct(
+                    (ndev, t_pad, 2), jnp.int32,
+                    sharding=NamedSharding(self.mesh, P("data", None, None)),
+                )
+            else:
+                tx = jax.ShapeDtypeStruct((t_pad, 2), jnp.int32)
             self._aot[key] = fn.lower(
                 self.params, self.train_x, self.train_y, self._postings,
                 tx, self._rowfeat,
@@ -943,10 +983,46 @@ class InfluenceEngine:
         crunching while the host moves on."""
         inject.fire(sites.ENGINE_DISPATCH_FLAT)
         counts = self.index.counts_batch(test_points)
-        total = int(counts.sum())
-        s_pad = self._s_pad_for(total)
         tx_np = np.ascontiguousarray(np.asarray(test_points, np.int64))
         T = tx_np.shape[0]
+        pad = bucketed_pad(
+            counts.max() if counts.size else 1, self.pad_bucket, pad_to
+        )
+        if self.mesh is not None:
+            ndev, q, t_loc, s_loc = self._mesh_plan(counts, T)
+            # Pack the batch into (ndev, t_loc, 2): shard k takes
+            # queries [k*q, (k+1)*q), short/empty shards duplicating
+            # their trailing real pair (the batch's last pair when the
+            # shard is past the ragged end) — exactly the single-device
+            # query-axis padding, so pad rows' flat positions land past
+            # each shard's real total and _assemble_packed slices them
+            # away per shard.
+            sh = np.empty((ndev, t_loc, 2), np.int64)
+            for k in range(ndev):
+                rows = tx_np[k * q: (k + 1) * q]
+                if rows.shape[0] == 0:
+                    rows = tx_np[-1:]
+                if rows.shape[0] < t_loc:
+                    rows = np.concatenate(
+                        [rows,
+                         np.repeat(rows[-1:], t_loc - rows.shape[0], axis=0)]
+                    )
+                sh[k] = rows
+            # the one sanctioned host→device transfer of the dispatch:
+            # placed along 'data' so each device receives only its own
+            # shard (works single- and multi-process)
+            from fia_tpu.parallel.distributed import put_global
+
+            tx = put_global(
+                self.mesh, sh.astype(np.int32), P("data", None, None)
+            )
+            out = self._flat_exec(t_loc, s_loc)(
+                self.params, self.train_x, self.train_y, self._postings,
+                tx, self._rowfeat,
+            )
+            return (test_points, counts, out, pad, (ndev, q, t_loc))
+        total = int(counts.sum())
+        s_pad = self._s_pad_for(total)
         t_pad = self._query_pad(T)
         if t_pad > T:
             # Query-axis padding: duplicate the trailing (u, i) pair up
@@ -960,24 +1036,16 @@ class InfluenceEngine:
                 [tx_np, np.repeat(tx_np[-1:], t_pad - T, axis=0)]
             )
         tx = jnp.asarray(tx_np, jnp.int32)
-        if self._multihost:
-            # cross-process jit operands must be global arrays; every
-            # process holds the same query batch (replicated input)
-            from fia_tpu.parallel.distributed import put_global
-
-            tx = put_global(self.mesh, tx, P())
         out = self._flat_exec(t_pad, s_pad)(
             self.params, self.train_x, self.train_y, self._postings, tx,
             self._rowfeat,
         )
-        pad = bucketed_pad(
-            counts.max() if counts.size else 1, self.pad_bucket, pad_to
-        )
-        return (test_points, counts, out, pad)
+        return (test_points, counts, out, pad, None)
 
     def _finalize_flat(self, handle) -> InfluenceResult:
-        test_points, counts, out, pad = handle
-        return self._assemble_packed(test_points, counts, out, pad)
+        test_points, counts, out, pad, shards = handle
+        return self._assemble_packed(test_points, counts, out, pad,
+                                     shards=shards)
 
     def _query_flat(
         self,
@@ -1235,7 +1303,8 @@ class InfluenceEngine:
             p["counts"], p["ihvp"], p["test_grad"],
         )
 
-    def _assemble_packed(self, test_points, counts, out, pad: int) -> InfluenceResult:
+    def _assemble_packed(self, test_points, counts, out, pad: int,
+                         shards=None) -> InfluenceResult:
         """Wrap flat device outputs as a packed (lazily padded) result.
 
         One device_get for all outputs (separate per-array fetches
@@ -1244,6 +1313,12 @@ class InfluenceEngine:
         contiguous-prefix mask rows consume the packed scores in device
         order (user postings then item postings) — consumers reading
         ``scores_of``/``related_of`` never pay for padding.
+
+        ``shards`` is the mesh dispatch's ``(ndev, q, t_loc)`` plan:
+        outputs then carry a leading shard axis and each shard's REAL
+        prefix (its own query count / row total) is sliced out and
+        concatenated back into stream order — the host-side inverse of
+        ``_dispatch_flat``'s shard packing.
         """
         if self._multihost:
             # outputs live partly on non-addressable devices; gather
@@ -1256,12 +1331,29 @@ class InfluenceEngine:
             )
         else:
             packed, ihvp, v = jax.device_get(out)
-        # Query-axis pad rows (duplicated trailing queries appended by
-        # _dispatch_flat) slice away here; their flat rows already sit
-        # past `total` in the packed scores.
         T = int(np.asarray(counts).shape[0])
-        ihvp = np.asarray(ihvp)[:T]
-        v = np.asarray(v)[:T]
+        if shards is not None:
+            ndev, q, _ = shards
+            cum = np.concatenate(
+                [[0], np.cumsum(np.asarray(counts, np.int64))]
+            )
+            pk, ih, vv = [], [], []
+            for k in range(ndev):
+                lo, hi = min(k * q, T), min((k + 1) * q, T)
+                if hi == lo:  # empty trailing shard (duplicate work)
+                    continue
+                pk.append(np.asarray(packed)[k, : int(cum[hi] - cum[lo])])
+                ih.append(np.asarray(ihvp)[k, : hi - lo])
+                vv.append(np.asarray(v)[k, : hi - lo])
+            packed = np.concatenate(pk)
+            ihvp = np.concatenate(ih)
+            v = np.concatenate(vv)
+        else:
+            # Query-axis pad rows (duplicated trailing queries appended
+            # by _dispatch_flat) slice away here; their flat rows
+            # already sit past `total` in the packed scores.
+            ihvp = np.asarray(ihvp)[:T]
+            v = np.asarray(v)[:T]
         # NaN injection site: a diverged solve returns a "successful"
         # buffer — corruption (and detection) happens on the fetched
         # host payload, exactly like the real failure mode.
@@ -1271,7 +1363,7 @@ class InfluenceEngine:
             counts=counts,
             ihvp=ihvp,
             test_grad=v,
-            packed=packed[:total],
+            packed=np.asarray(packed)[:total],
             test_points=np.asarray(test_points),
             index=self.index,
             pad=pad,

@@ -239,7 +239,7 @@ class FullInfluenceEngine:
         ty = jnp.asarray(np.asarray(test_y))
         exe = self._aot.get(("test_loss_grad", tuple(tx.shape)))
         if exe is not None:
-            return exe(self._flat0, tx, ty)
+            return exe(self._flat0, self._aot_in(tx), self._aot_in(ty))
         return self._test_loss_grad_jit(self._flat0, tx, ty)
 
     @partial(jax.jit, static_argnums=(0, 6))
@@ -286,7 +286,7 @@ class FullInfluenceEngine:
         while True:
             exe = self._aot.get(("solve", solver))
             if exe is not None:
-                x = exe(v, np.uint32(seed), self._flat0,
+                x = exe(self._aot_in(v), np.uint32(seed), self._flat0,
                         self.train_x, self.train_y)
             else:
                 x = self._solve(v, np.uint32(seed), self._flat0,
@@ -378,6 +378,19 @@ class FullInfluenceEngine:
         # ragged-tail rows re-read row 0; the slice drops their dots
         return (dots.reshape(nb * b)[:n] + reg_dot) / n
 
+    def _aot_in(self, x):
+        """Place a per-call operand for an AOT executable.
+
+        Compiled executables are strict about input placement: mesh
+        engines lower their programs with replicated input shardings
+        (precompile), so host-fresh operands (test batches, solve
+        directions) are re-placed to that layout here. No-op without a
+        mesh, and a no-copy no-op for arrays already so placed.
+        """
+        if self.mesh is None:
+            return x
+        return jax.device_put(x, NamedSharding(self.mesh, P()))
+
     def _fetch(self, arr) -> np.ndarray:
         """Host copy of a (possibly cross-process sharded) result."""
         if self._multihost:
@@ -391,7 +404,8 @@ class FullInfluenceEngine:
         """_score_all through the AOT executable when armed."""
         exe = self._aot.get(("score_all",))
         if exe is not None:
-            return exe(u, self._flat0, self.train_x, self.train_y)
+            return exe(self._aot_in(u), self._flat0,
+                       self.train_x, self.train_y)
         return self._score_all(u, self._flat0, self.train_x, self.train_y)
 
     def get_influence_on_test_loss(self, test_x, test_y, seed: int = 0):
@@ -420,7 +434,7 @@ class FullInfluenceEngine:
         tx = jnp.asarray(np.asarray(test_x))
         exe = self._aot.get(("pred_grad", tuple(tx.shape)))
         if exe is not None:
-            v = exe(self._flat0, tx)
+            v = exe(self._flat0, self._aot_in(tx))
         else:
             v = self._pred_grad_jit(self._flat0, tx)
         ihvp = self.get_inverse_hvp(v, seed=seed)
@@ -434,23 +448,32 @@ class FullInfluenceEngine:
         (``jax.jit(...).lower(...).compile()``) for ``n_test``-row test
         batches, so a warmed engine's first query pays no
         trace-or-compile: the test/prediction gradient, the iHVP solve
-        at the current solver rung, and the all-rows scoring jvp. Mesh
-        engines are left on the jit path (their global-array lowering
-        is exercised end-to-end by the distributed tests; AOT there
-        buys nothing — one process compiles either way).
+        at the current solver rung, and the all-rows scoring jvp.
+        Single-process mesh engines lower with their replicated input
+        shardings baked in (r7; per-call operands re-placed by
+        ``_aot_in``); cross-process engines stay on the jit path — AOT
+        there buys nothing, one process compiles either way.
 
         Returns ``{"compiled": [names], "cached": [names], "seconds"}``.
         """
-        if self.mesh is not None:
+        if self._multihost:
             return {"compiled": [], "cached": [], "seconds": 0.0}
         t0 = time.perf_counter()
         cls = type(self)
         flat = self._flat0
-        v = jax.ShapeDtypeStruct(flat.shape, flat.dtype)
-        tx = jax.ShapeDtypeStruct(
+        rep = (
+            None if self.mesh is None
+            else NamedSharding(self.mesh, P())
+        )
+        sds = lambda shape, dtype: (
+            jax.ShapeDtypeStruct(shape, dtype) if rep is None
+            else jax.ShapeDtypeStruct(shape, dtype, sharding=rep)
+        )
+        v = sds(flat.shape, flat.dtype)
+        tx = sds(
             (n_test,) + tuple(self.train_x.shape[1:]), self.train_x.dtype
         )
-        ty = jax.ShapeDtypeStruct((n_test,), self.train_y.dtype)
+        ty = sds((n_test,), self.train_y.dtype)
         jobs = {
             ("test_loss_grad", tuple(tx.shape)): lambda: cls
             ._test_loss_grad_jit.lower(self, flat, tx, ty),

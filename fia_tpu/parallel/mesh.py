@@ -36,6 +36,23 @@ def make_mesh(
     return Mesh(arr, axis_names)
 
 
+def mesh_fingerprint(mesh: Mesh | None):
+    """Hashable identity of a mesh layout, ``None`` for no mesh.
+
+    Keys every compiled-executable cache that must distinguish device
+    topologies (the engine's AOT geometry keys, serve-config/engine
+    consistency checks): same axis names, same shape, same devices in
+    the same order ⇒ same lowered shardings ⇒ reusable executable.
+    """
+    if mesh is None:
+        return None
+    return (
+        tuple(mesh.axis_names),
+        tuple(int(mesh.shape[a]) for a in mesh.axis_names),
+        tuple(int(d.id) for d in mesh.devices.flat),
+    )
+
+
 def shard_along(mesh: Mesh, tree, axis: str = "data", dim: int = 0):
     """Shard every leaf's ``dim`` dimension along a mesh axis."""
 

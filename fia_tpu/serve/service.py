@@ -75,9 +75,29 @@ class ServeConfig:
     # Overlapped dispatch: up to this many flat programs in flight per
     # drain, so host-side result assembly of batch N overlaps device
     # execution of batch N+1 (engine dispatch is async). 1 = the
-    # sequential guarded path; >1 applies only where the engine's flat
-    # path is eligible on a single device.
+    # sequential guarded path; >1 applies wherever the engine's flat
+    # path is eligible in this process (single device or a local mesh).
     dispatch_window: int = 2
+    # Serve over a device mesh: an int (shard the flat dispatch over
+    # the first N devices) or a jax Mesh with a 'data' axis. In
+    # fixed-engine mode the engine must already be built over the SAME
+    # mesh (validated at construction); from_model builds its engines
+    # over it. None (default) = whatever the engine was built with.
+    mesh: object | None = None
+
+
+def _resolve_mesh(mesh):
+    """ServeConfig.mesh → a jax Mesh (int = first-N-devices 'data'
+    mesh, <=1 or None = no mesh)."""
+    if mesh is None:
+        return None
+    if isinstance(mesh, int):
+        if mesh <= 1:
+            return None
+        from fia_tpu.parallel.mesh import make_mesh
+
+        return make_mesh(mesh)
+    return mesh
 
 
 class InfluenceService:
@@ -115,6 +135,18 @@ class InfluenceService:
             pad_bucket=int(getattr(self._peek_engine(), "pad_bucket", 128)),
         )
         eng = self._peek_engine()
+        self.mesh = _resolve_mesh(self.config.mesh)
+        if self.mesh is not None:
+            from fia_tpu.parallel.mesh import mesh_fingerprint
+
+            if mesh_fingerprint(getattr(eng, "mesh", None)) != \
+                    mesh_fingerprint(self.mesh):
+                raise ValueError(
+                    "ServeConfig.mesh does not match the engine's mesh; "
+                    "build the engine over the same mesh "
+                    "(InfluenceEngine(mesh=...) / cli mesh_for) or use "
+                    "from_model, which builds its engines over it"
+                )
         self.admission = AdmissionController(
             max_queue=self.config.max_queue,
             default_deadline_s=self.config.default_deadline_s,
@@ -140,8 +172,13 @@ class InfluenceService:
         one solver-resolution path), so ``model.retrain`` /
         ``update_train_x_y`` — which clear the model's engines and
         notify derived services — leave the service answering from
-        fresh state, never a stale hot block.
+        fresh state, never a stale hot block. A ``config.mesh`` is
+        forwarded into the engine build, so every refreshed engine
+        lands on the same device layout.
         """
+        m = _resolve_mesh((config or ServeConfig()).mesh)
+        if m is not None:
+            engine_extra.setdefault("mesh", m)
         svc = cls(
             engine_provider=lambda: model.engine(solver, **engine_extra),
             config=config, clock=clock,
@@ -241,15 +278,17 @@ class InfluenceService:
 
     def _overlap_eligible(self, eng) -> bool:
         """Windowed dispatch applies only where query_batch would run
-        one single-device flat dispatch per batch anyway — so the
-        overlapped stream is dispatch-for-dispatch the program sequence
-        the byte-identity contract pins."""
+        one flat dispatch per batch anyway — so the overlapped stream
+        is dispatch-for-dispatch the program sequence the byte-identity
+        contract pins. Local meshes qualify since r7 (the flat path
+        shards the query axis in-process); cross-process engines keep
+        the sequential guarded path."""
         return (
             int(self.config.dispatch_window) > 1
             and eng.impl in ("auto", "flat")
             and eng._flat_eligible()
             and not eng._wide_block_cap()
-            and eng.mesh is None
+            and not eng._multihost
         )
 
     def _dispatch_misses(self, eng, fp, misses, responses) -> None:
@@ -532,7 +571,7 @@ class InfluenceService:
         plan = self.batcher.plan(counts)
         flat_ok = (
             eng.impl in ("auto", "flat") and eng._flat_eligible()
-            and not eng._wide_block_cap() and eng.mesh is None
+            and not eng._wide_block_cap() and not eng._multihost
         )
         planned = []
         aot = {"compiled": [], "cached": [], "seconds": 0.0}

@@ -18,6 +18,13 @@ cd "$(dirname "$0")/.."
 DIR=$(mktemp -d /tmp/fia_chaos_smoke.XXXXXX)
 trap 'rm -rf "$DIR"' EXIT
 
+# serve_stream_mesh shards dispatch over a device mesh: give the CPU
+# host 8 virtual devices (same trick as tests/conftest.py) unless the
+# caller already forced a device count.
+if [[ "${XLA_FLAGS:-}" != *xla_force_host_platform_device_count* ]]; then
+  export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
+fi
+
 JAX_PLATFORMS=cpu timeout -k 10 300 python -m fia_tpu.cli.chaos \
   --smoke --workdir "$DIR"
 

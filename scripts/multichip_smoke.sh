@@ -1,0 +1,60 @@
+#!/usr/bin/env bash
+# Multichip smoke: the sharded dispatch path on 8 VIRTUAL CPU devices
+# (XLA_FLAGS=--xla_force_host_platform_device_count=8 — no chips
+# needed), via `python bench.py multichip --quick`, asserting on the
+# emitted artifact (docs/design.md §15):
+#   - details.device_sweep.rows is non-trivial: a row per device count
+#     1/2/4/8, each with a positive scores_per_sec (no "error" rows)
+#   - every row's steady_state_compiles == 0 (AOT geometry keyed by
+#     mesh fingerprint armed the executable; the hot path never traced)
+#   - the multi-device serving stage served requests with ZERO bitwise
+#     mismatches against the single-device service and zero steady
+#     compiles
+#
+#   bash scripts/multichip_smoke.sh        (or: make multichip-smoke)
+#
+# Budget: <120s on CPU — tiny synthetic splits, 800 training steps.
+# The artifact lands in a throwaway tmpdir so repeated runs stay
+# hermetic; copy it to output/MULTICHIP_r0N.json for a kept round.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+DIR=$(mktemp -d /tmp/fia_multichip_smoke.XXXXXX)
+trap 'rm -rf "$DIR"' EXIT
+
+XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+  timeout -k 10 420 python bench.py multichip --quick \
+  --json_out "$DIR/multichip.json"
+
+python - "$DIR/multichip.json" <<'EOF'
+import json, sys
+
+with open(sys.argv[1]) as fh:
+    out = json.load(fh)
+d = out["details"]
+assert d["device_count"] >= 8, f"virtual devices missing: {d['device_count']}"
+
+rows = d["device_sweep"]["rows"]
+devs = [r.get("devices") for r in rows]
+assert devs == [1, 2, 4, 8], f"sweep rows incomplete: {devs}"
+for r in rows:
+    assert "error" not in r, f"sweep row failed: {r}"
+    assert r["scores_per_sec"] > 0, f"trivial sweep row: {r}"
+    assert r["steady_state_compiles"] == 0, (
+        f"{r['devices']}dev dispatch compiled in steady state: {r}"
+    )
+
+md = d["serve_multi_device"]
+assert "error" not in md and "skipped" not in md, f"serve stage: {md}"
+assert md["ok"] > 0, f"multi-device serve served nothing: {md}"
+assert md["bitwise_mismatches_vs_single_device"] == 0, (
+    f"mesh serving diverged from single-device: {md}"
+)
+assert md["steady_state_compiles"] == 0, (
+    f"mesh serving compiled in steady state: {md}"
+)
+print(f"multichip smoke: sweep {devs} ok, "
+      f"serve {md['ok']} req on {md['devices']} devices bit-identical")
+EOF
+
+echo "multichip-smoke PASS"

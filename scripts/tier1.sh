@@ -28,6 +28,10 @@ bash scripts/chaos_smoke.sh || {
 # exactly the ROADMAP.md pytest command, the smoke just surfaces
 # serving regressions in the same log.
 bash scripts/serve_smoke.sh || echo "serve-smoke FAILED (non-fatal here; run make serve-smoke)"
+# Multichip smoke, NON-fatal for the same reason: the sharded dispatch
+# sweep on 8 virtual CPU devices (zero steady-state compiles per device
+# count, mesh serving bit-identical to single-device).
+bash scripts/multichip_smoke.sh || echo "multichip-smoke FAILED (non-fatal here; run make multichip-smoke)"
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
   -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
   -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log

@@ -235,6 +235,59 @@ class TestDispatchPath:
         assert res.ok, [f.render() for f in res.findings]
 
 
+class TestUnshardedTransfer:
+    """FIA205: no un-sharded ``jax.device_put`` on the registered
+    dispatch path — under a mesh it lands the batch on device 0 and
+    serializes the sharded dispatch (docs/design.md §15)."""
+
+    _ENGINE = "fia_tpu/influence/engine.py"
+
+    def test_unsharded_device_put_flagged(self, tmp_path):
+        res = _lint(tmp_path, {self._ENGINE: """\
+            import jax
+
+            def _dispatch_flat(sh):
+                tx = jax.device_put(sh)
+                return tx
+        """}, select={"FIA205"})
+        assert [f.rule for f in res.findings] == ["FIA205"]
+        assert "_dispatch_flat" in res.findings[0].message
+        assert "put_global" in res.findings[0].message
+
+    def test_sharded_and_helper_placements_clean(self, tmp_path):
+        res = _lint(tmp_path, {self._ENGINE: """\
+            import jax
+            from fia_tpu.parallel.distributed import put_global
+
+            def _dispatch_flat(mesh, sh, spec, ns):
+                a = put_global(mesh, sh, spec)  # the parallel/ helper
+                b = jax.device_put(sh, ns)  # explicit placement operand
+                c = jax.device_put(sh, sharding=ns)  # keyword spelling
+                return a, b, c
+        """}, select={"FIA205"})
+        assert res.ok, [f.render() for f in res.findings]
+
+    def test_unregistered_function_not_policed(self, tmp_path):
+        res = _lint(tmp_path, {self._ENGINE: """\
+            import jax
+
+            def some_helper(sh):
+                return jax.device_put(sh)
+        """}, select={"FIA205"})
+        assert res.ok
+
+    def test_real_dispatch_path_is_clean(self):
+        """Regression tripwire on the live repo: every device_put on
+        the registered dispatch path carries a placement (the sharded
+        scratch goes through parallel/distributed.put_global)."""
+        from fia_tpu.analysis.config import DISPATCH_PATH_FUNCTIONS
+
+        paths = sorted({os.path.join(REPO, p)
+                        for p, _ in DISPATCH_PATH_FUNCTIONS})
+        res = lint_paths(paths, select={"FIA205"}, root=REPO)
+        assert res.ok, [f.render() for f in res.findings]
+
+
 _SITES_FIXTURE = """\
     GOOD = "engine.solve"
     ALL_SITES = frozenset({GOOD})

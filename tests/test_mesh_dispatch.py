@@ -1,0 +1,187 @@
+"""Sharded flat-dispatch equivalence on the virtual CPU mesh (r7).
+
+The flat path on a mesh shards the QUERY axis: each device runs the
+single-device program on its own contiguous query shard (docs/design.md
+§15), so every device count must reproduce the single-device results
+BIT-identically — no collectives touch the scores. These tests pin that
+contract for query_batch, query_many (including a ragged final batch),
+and the serving layer, plus the plumbing that keeps the hot path
+compile-free: AOT geometry keys carry the mesh fingerprint, steady
+state never recompiles, and scratch donation cannot alias results.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from fia_tpu.data.dataset import RatingDataset
+from fia_tpu.influence.engine import InfluenceEngine
+from fia_tpu.models import MF
+from fia_tpu.parallel.mesh import make_mesh, mesh_fingerprint
+from fia_tpu.utils import compilemon
+
+DEVICE_COUNTS = (1, 2, 4, 8)
+
+
+def _setup(seed=0, n=400, users=20, items=16, k=4):
+    rng = np.random.default_rng(seed)
+    x = np.stack([rng.integers(0, users, n), rng.integers(0, items, n)],
+                 axis=1).astype(np.int32)
+    y = rng.integers(1, 6, n).astype(np.float32)
+    train = RatingDataset(x, y)
+    model = MF(users, items, k, 1e-3)
+    params = model.init_params(jax.random.PRNGKey(seed))
+    return model, params, train
+
+
+def _points(train, t, seed=7):
+    rng = np.random.default_rng(seed)
+    return train.x[rng.choice(len(train.x), size=t, replace=False)]
+
+
+@pytest.fixture(scope="module")
+def problem():
+    model, params, train = _setup()
+    single = InfluenceEngine(model, params, train, damping=1e-3,
+                             impl="flat")
+    return model, params, train, single
+
+
+class TestMeshEquivalence:
+    @pytest.mark.parametrize("ndev", DEVICE_COUNTS)
+    def test_query_batch_bit_identical(self, problem, ndev):
+        model, params, train, single = problem
+        pts = _points(train, 13)  # 13 % ndev != 0 for every ndev
+        eng = InfluenceEngine(model, params, train, damping=1e-3,
+                              mesh=make_mesh(ndev), impl="flat")
+        base = single.query_batch(pts)
+        got = eng.query_batch(pts)
+        assert np.array_equal(got.counts, base.counts)
+        assert np.array_equal(got.ihvp, base.ihvp)
+        for t in range(len(pts)):
+            assert np.array_equal(got.scores_of(t), base.scores_of(t))
+
+    @pytest.mark.parametrize("ndev", DEVICE_COUNTS)
+    def test_query_many_ragged_final_batch(self, problem, ndev):
+        """23 queries in batches of 5: the final 3-query batch is both
+        ragged (T < batch_queries) and smaller than the device count at
+        ndev 4/8 (empty shards padded with the batch's last pair)."""
+        model, params, train, single = problem
+        pts = _points(train, 23, seed=11)
+        eng = InfluenceEngine(model, params, train, damping=1e-3,
+                              mesh=make_mesh(ndev), impl="flat")
+        base = single.query_many(pts, batch_queries=5)
+        got = eng.query_many(pts, batch_queries=5)
+        assert len(got) == len(base)
+        for rb, rg in zip(base, got):
+            assert np.array_equal(rg.counts, rb.counts)
+            assert np.array_equal(rg.ihvp, rb.ihvp)
+            for t in range(rb.scores.shape[0]):
+                assert np.array_equal(rg.scores_of(t), rb.scores_of(t))
+
+
+class TestMeshCompileDiscipline:
+    def test_aot_key_carries_mesh_fingerprint(self, problem):
+        model, params, train, single = problem
+        mesh = make_mesh(4)
+        eng = InfluenceEngine(model, params, train, damping=1e-3,
+                              mesh=mesh, impl="flat")
+        assert single._aot_key(64, 2048)[-1] is None
+        assert eng._aot_key(64, 2048)[-1] == mesh_fingerprint(mesh)
+        # distinct meshes must never collide on an executable
+        eng2 = InfluenceEngine(model, params, train, damping=1e-3,
+                               mesh=make_mesh(2), impl="flat")
+        assert eng._aot_key(64, 2048) != eng2._aot_key(64, 2048)
+        assert eng._aot_key(64, 2048) != single._aot_key(64, 2048)
+
+    def test_zero_steady_state_compiles_on_mesh(self, problem):
+        model, params, train, _ = problem
+        eng = InfluenceEngine(model, params, train, damping=1e-3,
+                              mesh=make_mesh(4), impl="flat")
+        pts = _points(train, 10, seed=3)
+        geom = eng.flat_geometry(pts)
+        aot = eng.precompile_flat([geom])
+        assert list(geom) in aot["compiled"]
+        eng.query_batch(pts)  # warm the host packing path
+        c0 = compilemon.count()
+        eng.query_batch(pts)
+        eng.query_many(pts, batch_queries=len(pts))
+        assert compilemon.count() - c0 == 0
+
+    def test_donated_scratch_no_aliasing(self, problem, monkeypatch):
+        """Force the donation gate open on CPU: with the scratch buffer
+        donated (donate_argnums on the sharded executable), repeated
+        dispatches must stay bit-identical to the non-donated engine —
+        donation frees the per-dispatch scratch, never a buffer that
+        feeds later results."""
+        model, params, train, single = problem
+        eng = InfluenceEngine(model, params, train, damping=1e-3,
+                              mesh=make_mesh(4), impl="flat")
+        monkeypatch.setattr(eng, "_donate_scratch", lambda: True)
+        assert eng._aot_key(64, 2048)[4] is True  # key sees the gate
+        pts = _points(train, 9, seed=5)
+        eng.precompile_flat([eng.flat_geometry(pts)])
+        base = single.query_batch(pts)
+        first = eng.query_batch(pts)
+        second = eng.query_batch(pts)  # scratch of dispatch 1 is dead
+        for res in (first, second):
+            assert np.array_equal(res.counts, base.counts)
+            assert np.array_equal(res.ihvp, base.ihvp)
+            for t in range(len(pts)):
+                assert np.array_equal(res.scores_of(t), base.scores_of(t))
+
+
+class TestMeshServing:
+    def _requests(self, train, n=40):
+        from fia_tpu.serve import Request
+
+        rng = np.random.default_rng(19)
+        pool = train.x[rng.choice(len(train.x), size=12, replace=False)]
+        return [
+            Request(user=int(u), item=int(i), id=f"q{j}")
+            for j, (u, i) in enumerate(
+                pool[rng.integers(len(pool), size=n)]
+            )
+        ]
+
+    def test_serve_mesh_bit_identical_zero_recompiles(self, problem):
+        from fia_tpu.serve import InfluenceService, ServeConfig
+
+        model, params, train, _ = problem
+        mesh = make_mesh(4)
+        reqs = self._requests(train)
+        warm_pts = np.asarray(train.x[:16], np.int64)
+
+        def run(m):
+            eng = InfluenceEngine(model, params, train, damping=1e-3,
+                                  impl="flat", mesh=m)
+            svc = InfluenceService(engine=eng, config=ServeConfig(
+                max_batch=8, mesh=m, disk_cache=False))
+            info = svc.warmup(warm_pts)
+            assert info["all_planned_compiled"]
+            svc.run(list(reqs), drain_every=8)  # warm pass
+            c0 = compilemon.count()
+            resp = svc.run(list(reqs), drain_every=8)
+            return resp, compilemon.count() - c0
+
+        base, _ = run(None)
+        got, steady = run(mesh)
+        assert steady == 0
+        by_id = {r.id: r for r in base}
+        assert all(r.ok for r in got)
+        for r in got:
+            assert np.array_equal(r.scores, by_id[r.id].scores)
+
+    def test_serve_config_mesh_must_match_engine(self, problem):
+        from fia_tpu.serve import InfluenceService, ServeConfig
+        from fia_tpu.serve.service import _resolve_mesh
+
+        model, params, train, single = problem
+        assert _resolve_mesh(None) is None
+        assert _resolve_mesh(0) is None
+        assert _resolve_mesh(1) is None
+        m = _resolve_mesh(2)
+        assert mesh_fingerprint(m) == mesh_fingerprint(make_mesh(2))
+        with pytest.raises(ValueError, match="mesh"):
+            InfluenceService(engine=single,
+                             config=ServeConfig(mesh=2, disk_cache=False))

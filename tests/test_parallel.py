@@ -65,9 +65,12 @@ class TestShardedInfluence:
         assert res.scores.shape[0] == 3
 
     def test_flat_on_mesh_matches_padded(self):
-        """The flat segment-sum path sharded over the mesh (per-device
-        partial Hessians + psum) must equal the padded mesh path and the
-        single-device flat path."""
+        """The flat path on a mesh shards the QUERY axis (each device
+        runs the single-device program on its own shard, r7), so it is
+        BIT-identical to the single-device flat path; the padded mesh
+        path must agree within the 1e-5 pin (its T-wide solve selects a
+        different batched-LU kernel than the canonical query_bucket
+        batch — the same divergence pinned in TestShardedTables)."""
         model, params, train = _setup()
         pts = np.array([[3, 5], [0, 1], [7, 2], [11, 9], [1, 1]])
         mesh = make_mesh(8)
@@ -83,10 +86,10 @@ class TestShardedInfluence:
         assert np.array_equal(a.counts, b.counts)
         for t in range(len(pts)):
             np.testing.assert_allclose(a.scores_of(t), b.scores_of(t),
-                                       rtol=1e-4, atol=1e-6)
-            np.testing.assert_allclose(a.scores_of(t), c.scores_of(t),
-                                       rtol=1e-4, atol=1e-6)
-        np.testing.assert_allclose(a.ihvp, b.ihvp, rtol=1e-4, atol=1e-6)
+                                       rtol=1e-4, atol=1e-5)
+            assert np.array_equal(a.scores_of(t), c.scores_of(t))
+        np.testing.assert_allclose(a.ihvp, b.ihvp, rtol=1e-4, atol=1e-5)
+        assert np.array_equal(a.ihvp, c.ihvp)
 
 
 class TestShardedTables:
