@@ -1,7 +1,7 @@
 # Convenience targets. The commands themselves are pinned in
 # ROADMAP.md (tier-1) and scripts/ — these targets just name them.
 
-.PHONY: tier1 test lint lint-io serve-smoke multichip-smoke chaos-smoke chaos-soak
+.PHONY: tier1 test lint lint-io serve-smoke multichip-smoke factor-smoke chaos-smoke chaos-soak
 
 # The ROADMAP.md tier-1 verify: fast CPU suite, slow tests excluded.
 # Lint is fatal — a finding fails the build before pytest runs.
@@ -35,6 +35,13 @@ serve-smoke:
 # single-device. docs/design.md §15 has the mesh design.
 multichip-smoke:
 	bash scripts/multichip_smoke.sh
+
+# Factor smoke: build a tiny factor bank on CPU (<60s), serve against
+# it in-process — verified artifact load, bank hits at Spearman >= 0.999
+# vs the direct solver, bitwise miss fall-through to the bank-less
+# ladder. docs/design.md §16 has the factor-bank design.
+factor-smoke:
+	bash scripts/factor_smoke.sh
 
 # Chaos smoke: fixed-seed benign fault schedules against the three
 # end-to-end scenarios (train→kill→resume, cached query_many, serve

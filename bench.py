@@ -169,6 +169,118 @@ def _device_sweep(model, params, train, pool, damping) -> dict:
     return out
 
 
+def _solver_tier(model, params, train, damping) -> dict:
+    """Precomputed factor-bank tier A/B (docs/design.md §16).
+
+    Builds a bank over the hot (user, item) pairs in-process (the
+    ``python -m fia_tpu.cli.factor`` pass), loads it into a
+    ``solver="precomputed"`` engine, and times the steady-state hot-set
+    protocol: the SAME banked query set through (a) the bank hit path
+    (one triangular solve / matvec per query), (b) a bank-less
+    ``lissa`` engine — the rung a miss falls through to, so the ratio
+    is hit vs miss at equal work — and (c) the exact ``direct`` solver
+    as the fidelity anchor (per-query Spearman). A mixed half-banked
+    stream then exercises the hit/miss partition so the recorded
+    counts show both sides of the split, and ``bank_stats`` carries the
+    engine's cumulative per-tier accounting."""
+    import tempfile
+
+    from fia_tpu.data.synthetic import sample_heldout_pairs
+    from fia_tpu.eval.metrics import spearman
+    from fia_tpu.influence import factor as fbank
+    from fia_tpu.influence.engine import InfluenceEngine
+
+    entries = 64 if QUICK else 256
+    # The miss rung runs at the serving default (the reference's
+    # 10k-deep LiSSA recursion); --quick caps the depth and times the
+    # rung on a query subset so the CPU artifact stays minutes, not
+    # hours — both knobs are recorded, and both make the reported
+    # speedup an UNDER-estimate (a shallower, smaller lissa pass can
+    # only look faster).
+    lissa_depth = 1_000 if QUICK else 10_000
+    lissa_queries = 16 if QUICK else None
+    cache_dir = tempfile.mkdtemp(prefix="fia-bench-factor-")
+    name = "bench-mf"
+
+    def mk(solver, cache):
+        return InfluenceEngine(
+            model, params, train, damping=damping, solver=solver,
+            cache_dir=cache_dir if cache else None, model_name=name,
+            pad_bucket=512, lissa_depth=lissa_depth,
+        )
+
+    builder = mk("direct", cache=True)
+    hot = fbank.select_hot_pairs(builder.index, max_entries=entries)
+    bank = fbank.build_bank(builder, hot, batch_queries=entries)
+    fp = fbank.bank_fingerprint(name, model.block_size, damping,
+                                *builder._train_host)
+    fbank.publish_bank(bank, builder.factor_bank_path(), fp)
+
+    eng = mk("precomputed", cache=True)
+    loaded = eng.ensure_factor_bank()
+    pts = np.asarray(bank.pairs, np.int64)  # all-hit workload
+    out = {"bank_entries": int(len(bank)), "loaded": int(loaded),
+           "queries": int(len(pts)), "lissa_depth": lissa_depth}
+
+    tiers = {}
+    res_by_tier = {}
+    for tier, eng_t in (("precomputed", eng),
+                        ("lissa_miss_path", mk("lissa", cache=False)),
+                        ("direct", mk("direct", cache=False))):
+        tp = pts
+        if tier == "lissa_miss_path" and lissa_queries:
+            tp = pts[:lissa_queries]
+        res_by_tier[tier] = eng_t.query_batch(tp)  # compile + warm
+        best_dt = float("inf")
+        for _ in range(3):
+            best_dt = min(best_dt,
+                          _timed(lambda e=eng_t, p=tp: e.query_batch(p)))
+        n_scores = int(res_by_tier[tier].counts.sum())
+        tiers[tier] = {
+            "queries": int(len(tp)),
+            "scores_per_sec": round(n_scores / best_dt, 1),
+            "per_query_ms": round(best_dt / len(tp) * 1e3, 3),
+            "per_query_us": round(best_dt / len(tp) * 1e6, 1),
+        }
+        _stage(f"solver tier {tier}: "
+               f"{tiers[tier]['scores_per_sec']:.0f} scores/s")
+    out["tiers"] = tiers
+    out["speedup_vs_lissa_miss_path"] = round(
+        tiers["precomputed"]["scores_per_sec"]
+        / tiers["lissa_miss_path"]["scores_per_sec"], 2,
+    )
+    rhos = [spearman(res_by_tier["precomputed"].scores_of(t),
+                     res_by_tier["direct"].scores_of(t))
+            for t in range(len(pts))]
+    out["spearman_vs_direct_min"] = round(float(min(rhos)), 6)
+    out["spearman_vs_direct_median"] = round(float(np.median(rhos)), 6)
+
+    # mixed half-banked stream: half the banked set plus an equal count
+    # of never-banked held-out pairs, so the partition + merge path and
+    # both sides of the hit/miss accounting get exercised
+    pool = sample_heldout_pairs(train.x, model.num_users,
+                                model.num_items, 4 * len(pts), seed=43)
+    cold = np.asarray(
+        [p for p in pool.tolist()
+         if not eng.bank_contains(p[0], p[1])][: max(len(pts) // 2, 1)],
+        np.int64,
+    )
+    before = eng.bank_stats()
+    mixed = np.concatenate([pts[: len(cold)], cold])
+    t0 = time.perf_counter()
+    eng.query_batch(mixed)
+    mixed_dt = time.perf_counter() - t0
+    after = eng.bank_stats()
+    out["mixed_stream"] = {
+        "queries": int(len(mixed)),
+        "hits": after["hits"] - before["hits"],
+        "misses": after["misses"] - before["misses"],
+        "wall_ms": round(mixed_dt * 1e3, 2),
+    }
+    out["bank_stats"] = after
+    return out
+
+
 def _serve_multidevice(model, params, train, pool, damping) -> dict:
     """Multi-device serving steady state: the same request stream
     through a single-device service and a mesh service
@@ -444,9 +556,14 @@ def main():
             prev = 0.0
             for st in stages:
                 cum = max(best[st], prev)
-                device_split[st + "_ms"] = round((cum - prev) * 1e3, 2)
+                # µs resolution (3 decimals of ms): the solve stage is a
+                # tiny batched LU on (q, d, d) blocks and rounded to
+                # 0.00 ms at the old 10 µs floor, leaving the
+                # solver_tier section with no honest solve denominator
+                device_split[st + "_ms"] = round((cum - prev) * 1e3, 3)
+                device_split[st + "_us"] = round((cum - prev) * 1e6, 1)
                 prev = cum
-            device_split["full_program_ms"] = round(prev * 1e3, 2)
+            device_split["full_program_ms"] = round(prev * 1e3, 3)
             log.log("device_split", model="MF", **device_split)
         except Exception as e:  # noqa: BLE001
             device_split = {"error": repr(e)}
@@ -565,6 +682,20 @@ def main():
     except Exception as e:  # noqa: BLE001 — keep the headline rows
         _stage(f"device sweep FAILED: {e!r}")
         device_sweep = {"error": repr(e)}
+
+    # --- solver tier: precomputed factor-bank A/B (docs/design.md §16) --
+    # Best-effort like the other optional stages; runs in --quick too so
+    # the CPU-synthetic artifact also carries the section.
+    try:
+        _stage("solver tier: building factor bank + steady-state A/B")
+        solver_tier = _solver_tier(model, params, train, damping)
+        log.log("solver_tier", model="MF", **solver_tier)
+        _stage(f"solver tier: {solver_tier['speedup_vs_lissa_miss_path']}x "
+               f"vs lissa miss path, worst Spearman "
+               f"{solver_tier['spearman_vs_direct_min']}")
+    except Exception as e:  # noqa: BLE001 — keep the headline rows
+        _stage(f"solver tier stage FAILED: {e!r}")
+        solver_tier = {"error": repr(e)}
     _stage(f"running CPU reference on {n_base} queries")
 
     # --- CPU baseline (reference-architecture engine) on a sample -------
@@ -743,6 +874,7 @@ def main():
             "device_split": device_split,
             "dispatch": dispatch,
             "device_sweep": device_sweep,
+            "solver_tier": solver_tier,
             "ncf": ncf_out,
         },
     }

@@ -120,11 +120,47 @@ class FIAModel:
 
     def _invalidate(self):
         """Every derived-state holder learns the params/train set moved:
-        engines are dropped (rebuilt lazily from the new state) and any
-        serving layer clears its hot caches and memoized fingerprints."""
+        the published factor bank is surgically refreshed (entries whose
+        dependency digests still match the new state survive under the
+        new fingerprint; touched entries are dropped — never served
+        stale), engines are dropped (rebuilt lazily from the new state)
+        and any serving layer clears its hot caches and memoized
+        fingerprints."""
+        self._refresh_factor_bank()
         self._engines.clear()
         for svc in list(self._serving):
             svc.invalidate()
+
+    def _refresh_factor_bank(self):
+        """Surgical factor-bank invalidation on a params/train change
+        (see :func:`fia_tpu.influence.factor.refresh_bank`). A missing
+        bank is a no-op; refresh failures must never block the state
+        change itself (the per-entry digests already make stale serving
+        impossible — this pass just republishes the survivors)."""
+        if not self.train_dir:
+            return
+        from fia_tpu.influence import factor as fbank
+
+        path = fbank.default_bank_path(self.train_dir, self.model_name)
+        if not os.path.exists(path):
+            return
+        from fia_tpu.data.index import InteractionIndex
+
+        train = self.data_sets["train"]
+        params_host = jax.tree_util.tree_map(np.asarray, self.state.params)
+        index = InteractionIndex(
+            np.asarray(train.x), self.model.num_users, self.model.num_items
+        )
+        stats = fbank.refresh_bank(
+            self.model, params_host, np.asarray(train.x),
+            np.asarray(train.y), index, self.damping, path,
+            self.model_name,
+        )
+        if stats["dropped"]:
+            print(
+                f"[factor-bank] params change: kept {stats['kept']} "
+                f"entries, dropped {stats['dropped']} stale"
+            )
 
     def _register_serving(self, svc) -> None:
         self._serving.add(svc)
@@ -227,10 +263,12 @@ class FIAModel:
         if loss_type != "normal_loss":
             raise ValueError("loss must be normal_loss")
         eng = self.engine()
-        if approx_type and approx_type not in ("direct", "cg", "lissa", "schulz"):
+        if approx_type and approx_type not in (
+            "direct", "cg", "lissa", "schulz", "precomputed"
+        ):
             raise ValueError(
                 f"unknown approx_type {approx_type!r}; "
-                "use direct|cg|lissa|schulz"
+                "use direct|cg|lissa|schulz|precomputed"
             )
         if (approx_type and approx_type != eng.solver) or approx_params:
             # approx_params keys are InfluenceEngine kwargs

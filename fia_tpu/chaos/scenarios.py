@@ -571,6 +571,156 @@ class ServeStreamMeshScenario(ServeStreamScenario):
         return failures
 
 
+class FactorBankScenario(Scenario):
+    """Factor-bank publish → verified load → O(1) hit serving → miss
+    fall-through, under artifact damage and load faults.
+
+    Each run republishes the (fixed, precomputed) bank into its workdir
+    — the ``factor.publish`` damage point — then a ``precomputed``
+    engine attempts the verified load (``engine.factor_load``) and
+    serves every banked pair plus a few unbanked ones, one query at a
+    time. A torn/bit-rotted/stale-manifest bank must quarantine and
+    degrade to the solver ladder; a transient load fault must degrade
+    the same way; and in every case each served answer must be
+    byte-identical to one of the two fault-free references computed at
+    construction (bank hit or bank-less ladder — anything else is a
+    silent wrong answer). Miss-pair scores go into the outcome payload:
+    they are served by the ladder regardless of bank health, so benign
+    schedules must reproduce them bit-identically.
+    """
+
+    name = "factor_bank"
+    NPAIRS, NMISS = 8, 3
+    benign_domain = {
+        sites.FACTOR_PUBLISH: (_DAMAGE_KINDS, 1),
+        sites.ENGINE_FACTOR_LOAD: (_TRANSIENT_KINDS, 1),
+    }
+    full_domain = {
+        sites.FACTOR_PUBLISH: (_DAMAGE_KINDS, 1),
+        sites.ENGINE_FACTOR_LOAD: (
+            _TRANSIENT_KINDS + (taxonomy.HOST_OOM,), 1),
+        sites.ENGINE_SOLVE: ((taxonomy.NAN,), 1),
+        sites.CHAOS_SCENARIO: ((taxonomy.WORKER,), 1),
+    }
+
+    def __init__(self):
+        import tempfile
+
+        import jax
+
+        from fia_tpu.data.dataset import RatingDataset
+        from fia_tpu.influence import factor as fbank
+        from fia_tpu.influence.engine import InfluenceEngine
+        from fia_tpu.models import MF
+
+        x, y = _toy_data(0, 400)
+        self.model = MF(_U, _I, _K, _WD)
+        params = self.model.init_params(jax.random.PRNGKey(0))
+        train = RatingDataset(x, y)
+        builder = InfluenceEngine(
+            self.model, params, train, damping=_DAMP,
+            model_name="chaos-factor", lissa_depth=30)
+        pairs = fbank.select_hot_pairs(
+            builder.index, max_entries=self.NPAIRS,
+            top_users=4, top_items=4)
+        # the bank content is fixed; runs only re-PUBLISH it (that is
+        # where the damage channel bites), never re-factorize
+        self.bank = fbank.build_bank(builder, pairs)
+        self.fp = fbank.bank_fingerprint(
+            "chaos-factor", self.model.block_size, _DAMP,
+            *builder._train_host)
+        self.pairs = [(int(u), int(i)) for u, i in pairs]
+        banked = set(self.pairs)
+        self.miss_pairs = [
+            (int(u), int(i))
+            for u, i in zip(x[:, 0], x[:, 1])
+            if (int(u), int(i)) not in banked
+        ][: self.NMISS]
+
+        # one precomputed engine for every run (compiled programs are
+        # shared); its cache_dir/bank state is re-pointed per run
+        self.eng = InfluenceEngine(
+            self.model, params, train, damping=_DAMP,
+            solver="precomputed", cache_dir=tempfile.mkdtemp(
+                prefix="fia-chaos-factor-init-"),
+            model_name="chaos-factor", lissa_depth=30)
+
+        # fault-free references: bank-hit bytes and bank-less ladder
+        # bytes per pair, each queried alone (T=1) so per-pair results
+        # are independent of what else is in a batch
+        path = fbank.default_bank_path(self.eng.cache_dir, "chaos-factor")
+        fbank.publish_bank(self.bank, path, self.fp)
+        assert self.eng.ensure_factor_bank() == len(self.bank)
+        self.ref_bank = [
+            self._one(self.eng, p).tobytes() for p in self.pairs
+        ]
+        ladder = InfluenceEngine(
+            self.model, params, train, damping=_DAMP, solver="lissa",
+            model_name="chaos-factor", lissa_depth=30)
+        self.ref_ladder = [
+            self._one(ladder, p).tobytes() for p in self.pairs
+        ]
+
+    @staticmethod
+    def _one(engine, pair) -> np.ndarray:
+        res = engine.query_batch(np.asarray([pair], np.int64))
+        return np.asarray(res.scores_of(0))
+
+    def run(self, workdir: str, events: list) -> dict:
+        from fia_tpu.influence import factor as fbank
+
+        eng = self.eng
+        eng.cache_dir = os.path.join(workdir, "cache")
+        eng.unload_factor_bank()
+        eng.solver = "precomputed"  # undo any sticky prior escalation
+        path = fbank.default_bank_path(eng.cache_dir, eng.model_name)
+        fbank.publish_bank(self.bank, path, self.fp)
+        n = eng.ensure_factor_bank()
+        events.append({"event": "bank_loaded", "entries": int(n)})
+
+        for k, pair in enumerate(self.pairs):
+            b = self._one(eng, pair).tobytes()
+            via = ("bank" if b == self.ref_bank[k]
+                   else "ladder" if b == self.ref_ladder[k]
+                   else "neither")
+            events.append({"event": "pair_served", "pair": k, "via": via})
+
+        out: dict = {}
+        for k, pair in enumerate(self.miss_pairs):
+            out[f"miss{k}"] = self._one(eng, pair).copy()
+        out["pairs_total"] = len(self.pairs)
+        events.append({"event": "bank_stats", **eng.bank_stats()})
+        return out
+
+    def check(self, golden: dict, record) -> list:
+        from fia_tpu.chaos.oracles import OracleFailure
+
+        if record.error is not None or record.outcome is None:
+            return []
+        failures = []
+        entries = next(
+            (e["entries"] for e in record.events
+             if e.get("event") == "bank_loaded"), 0)
+        for e in record.events:
+            if e.get("event") != "pair_served":
+                continue
+            if e["via"] == "neither":
+                failures.append(OracleFailure(
+                    "factor_serving_integrity",
+                    f"pair {e['pair']}: served scores match neither the "
+                    "bank reference nor the ladder reference "
+                    "(silent wrong answer)",
+                ))
+            elif entries == 0 and e["via"] != "ladder":
+                failures.append(OracleFailure(
+                    "factor_fall_through",
+                    f"pair {e['pair']} served via {e['via']} with no "
+                    "bank loaded — a rejected bank must degrade to the "
+                    "solver ladder",
+                ))
+        return failures
+
+
 def make_scenarios() -> dict:
     """Fresh scenario registry (instances are lazily constructed so the
     selftest path never imports jax)."""
@@ -581,6 +731,7 @@ def make_scenarios() -> dict:
         QueryCacheScenario.name: QueryCacheScenario,
         ServeStreamScenario.name: ServeStreamScenario,
         ServeStreamMeshScenario.name: ServeStreamMeshScenario,
+        FactorBankScenario.name: FactorBankScenario,
     }
 
 

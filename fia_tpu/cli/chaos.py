@@ -38,7 +38,7 @@ from fia_tpu.chaos.scenarios import SCENARIO_NAMES
 # forces 8 virtual CPU devices); on a 1-device host it degrades to the
 # single-device workload rather than failing.
 SMOKE_SCENARIOS = ("selftest", "train_resume", "query_cache",
-                   "serve_stream", "serve_stream_mesh")
+                   "serve_stream", "serve_stream_mesh", "factor_bank")
 SMOKE_SEEDS_PER_SCENARIO = 2
 SMOKE_FAULTS = 3
 

@@ -65,7 +65,8 @@ def base_parser(desc: str) -> argparse.ArgumentParser:
                    choices=[None, "tpu", "cpu"],
                    help="force a JAX platform (default: auto)")
     p.add_argument("--solver", type=str, default="direct",
-                   choices=["direct", "cg", "lissa", "schulz"])
+                   choices=["direct", "cg", "lissa", "schulz",
+                            "precomputed"])
     p.add_argument("--cg_maxiter", type=int, default=100,
                    help="CG iteration cap (reference fmin_ncg maxiter, "
                         "matrix_factorization.py:431)")

@@ -109,6 +109,15 @@ class InteractionIndex:
             + self._i_indptr[i + 1] - self._i_indptr[i]
         )
 
+    def user_degrees(self) -> np.ndarray:
+        """Interaction count per user id, (num_users,) int64 — the
+        hotness signal the factor-bank selector ranks on."""
+        return np.diff(self._u_indptr)
+
+    def item_degrees(self) -> np.ndarray:
+        """Interaction count per item id, (num_items,) int64."""
+        return np.diff(self._i_indptr)
+
     def max_related_count(self) -> int:
         """Upper bound on any query's related-set size: the heaviest user
         degree plus the heaviest item degree. Padding to this ceiling

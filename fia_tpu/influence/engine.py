@@ -218,7 +218,7 @@ class InfluenceEngine:
         cpu_fallback: bool = True,
         query_bucket: int = 64,
     ):
-        if solver not in ("direct", "cg", "lissa", "schulz"):
+        if solver not in ("direct", "cg", "lissa", "schulz", "precomputed"):
             raise ValueError(f"unknown solver {solver!r}")
         self.model = model
         if shard_tables and (mesh is None or "model" not in mesh.axis_names):
@@ -376,6 +376,21 @@ class InfluenceEngine:
         self.cpu_fallback = bool(cpu_fallback)
         self._is_cpu_fallback = False
         self._cpu_engine: "InfluenceEngine | None" = None
+        # Precomputed factor-bank tier (solver='precomputed'): hot
+        # (u, i) pairs answer from factorized block inverses published
+        # offline (cli/factor.py -> influence/factor.py), one
+        # triangular-solve/matvec inside the flat dispatch; everything
+        # else — missing entry, stale params digest, damaged artifact,
+        # mesh/hook ineligibility — falls through to a config-identical
+        # delegate at the next ladder rung (policy.QUERY_SOLVER_FALLBACK).
+        self._bank = None
+        self._bank_lookup: dict | None = None
+        self._bank_device = None  # (factor (N,d,d), kind (N,)) on device
+        self._bank_load_attempted = False
+        self._bank_dropped_stale = 0
+        self._bank_hits = 0
+        self._bank_misses = 0
+        self._bank_delegate: "InfluenceEngine | None" = None
 
     def _upload_device_state(self) -> None:
         """(Re)build every device-resident tensor from host copies.
@@ -1398,6 +1413,461 @@ class InfluenceEngine:
             self._jitted[key] = jax.jit(fn)
         return self._jitted[key]
 
+    # -- precomputed factor-bank tier --------------------------------------
+    def block_hessians(self, pairs: np.ndarray,
+                       batch_queries: int = 512) -> np.ndarray:
+        """Damped block Hessians for explicit (u, i) pairs, (N, d, d)
+        host numpy — the factor-bank build's input.
+
+        Rides the flat mega-batch program's ``hessian`` stage (ONE
+        fused dispatch per ``batch_queries`` chunk, mesh-sharded when
+        the engine carries a mesh) whenever the model's Gauss-Newton
+        hooks allow; models without the hooks (or an explicit
+        ``hessian_mode='autodiff'``) fall back to a vmapped per-pair
+        materialisation over the padded related sets.
+        """
+        pairs = np.asarray(pairs, np.int64)
+        if pairs.ndim == 1:
+            pairs = pairs[None, :]
+        gn_ok = (
+            self.model.block_cross_const is not None
+            and self.model.block_reg_diag is not None
+            and self.hessian_mode != "autodiff"
+        )
+        out = []
+        for s0 in range(0, len(pairs), max(int(batch_queries), 1)):
+            chunk = pairs[s0: s0 + max(int(batch_queries), 1)]
+            out.append(
+                self._block_hessians_flat(chunk) if gn_ok
+                else self._block_hessians_padded(chunk)
+            )
+        return out[0] if len(out) == 1 else np.concatenate(out)
+
+    def _block_hessians_flat(self, chunk: np.ndarray) -> np.ndarray:
+        counts = self.index.counts_batch(chunk)
+        tx_np = np.ascontiguousarray(chunk)
+        T = tx_np.shape[0]
+        if self.mesh is not None:
+            # same shard packing as _dispatch_flat: contiguous query
+            # shards along 'data', trailing-pair duplication per shard
+            ndev, q, t_loc, s_loc = self._mesh_plan(counts, T)
+            sh = np.empty((ndev, t_loc, 2), np.int64)
+            for k in range(ndev):
+                rows = tx_np[k * q: (k + 1) * q]
+                if rows.shape[0] == 0:
+                    rows = tx_np[-1:]
+                if rows.shape[0] < t_loc:
+                    rows = np.concatenate(
+                        [rows,
+                         np.repeat(rows[-1:], t_loc - rows.shape[0], axis=0)]
+                    )
+                sh[k] = rows
+            from fia_tpu.parallel.distributed import put_global
+
+            tx = put_global(
+                self.mesh, sh.astype(np.int32), P("data", None, None)
+            )
+            hess = self._flat_fn(s_loc, "hessian")(
+                self.params, self.train_x, self.train_y, self._postings,
+                tx, self._rowfeat,
+            )
+            if self._multihost:
+                from jax.experimental import multihost_utils
+
+                hess = multihost_utils.process_allgather(hess, tiled=True)
+            hess = np.asarray(jax.device_get(hess))
+            parts = []
+            for k in range(ndev):
+                lo, hi = min(k * q, T), min((k + 1) * q, T)
+                if hi > lo:
+                    parts.append(hess[k, : hi - lo])
+            return np.concatenate(parts)
+        t_pad = self._query_pad(T)
+        if t_pad > T:
+            tx_np = np.concatenate(
+                [tx_np, np.repeat(tx_np[-1:], t_pad - T, axis=0)]
+            )
+        s_pad = self._s_pad_for(int(counts.sum()))
+        hess = self._flat_fn(s_pad, "hessian")(
+            self.params, self.train_x, self.train_y, self._postings,
+            jnp.asarray(tx_np, jnp.int32), self._rowfeat,
+        )
+        return np.asarray(jax.device_get(hess))[:T]
+
+    def _block_hessians_padded(self, chunk: np.ndarray) -> np.ndarray:
+        idx, mask, _ = self.index.related_padded(
+            chunk, bucket=self.pad_bucket
+        )
+        model, damping = self.model, self.damping
+        d = int(model.block_size)
+
+        def one(uu, ii, ridx, m):
+            rel_x = self.train_x[ridx]
+            rel_y = self.train_y[ridx]
+            w = m.astype(jnp.float32)
+            if self._analytic_hessian:
+                Hm = model.block_hessian(
+                    self.params, uu, ii, rel_x, rel_y, w
+                )
+                return Hm + damping * jnp.eye(d, dtype=jnp.float32)
+            return H.materialize_block_hessian(
+                model, self.params, uu, ii, rel_x, rel_y, w, damping
+            )
+
+        hess = jax.jit(jax.vmap(one))(
+            jnp.asarray(chunk[:, 0], jnp.int32),
+            jnp.asarray(chunk[:, 1], jnp.int32),
+            jnp.asarray(idx, jnp.int32),
+            jnp.asarray(mask),
+        )
+        return np.asarray(jax.device_get(hess))
+
+    def factor_bank_path(self) -> str | None:
+        """Default on-disk bank location (None without a cache_dir)."""
+        if self.cache_dir is None:
+            return None
+        from fia_tpu.influence import factor as fbank
+
+        return fbank.default_bank_path(self.cache_dir, self.model_name)
+
+    def load_factor_bank(self, path: str | None = None) -> int:
+        """Load (or reload) the factor bank device-resident.
+
+        A *verified* load: artifact checksum + config/train fingerprint
+        first (corrupt banks quarantine as ``*.corrupt``), then the
+        per-entry ``dep_crc`` revalidation against the live params —
+        stale entries are dropped before the bank ever serves. Any
+        integrity failure or taxonomy-classified fault degrades to "no
+        bank" (every query falls through the ladder); unclassified
+        errors surface. Returns the number of servable entries.
+        """
+        from fia_tpu.influence import factor as fbank
+        from fia_tpu.reliability import artifacts
+
+        self._bank_load_attempted = True
+        self._bank = None
+        self._bank_lookup = None
+        self._bank_device = None
+        if path is None:
+            path = self.factor_bank_path()
+        if path is None or not os.path.exists(path):
+            return 0
+        try:
+            inject.fire(sites.ENGINE_FACTOR_LOAD)
+            bank, dropped = fbank.load_bank(path, self)
+        except artifacts.ArtifactIntegrityError as e:
+            print(
+                f"[reliability] factor bank rejected ({e.reason}); "
+                "queries fall through the solver ladder"
+            )
+            return 0
+        except Exception as e:
+            if taxonomy.classify(e) is None:
+                raise
+            print(
+                "[reliability] factor bank load failed transiently; "
+                "serving without the bank"
+            )
+            return 0
+        self._bank_dropped_stale = int(dropped)
+        if len(bank) == 0:
+            return 0
+        self._bank = bank
+        self._bank_lookup = bank.lookup()
+        self._bank_device = (
+            jnp.asarray(bank.factor),
+            jnp.asarray(bank.kind.astype(np.int32)),
+        )
+        return len(bank)
+
+    def ensure_factor_bank(self) -> int:
+        """Load the bank once, lazily; returns servable entry count."""
+        if not self._bank_load_attempted:
+            self.load_factor_bank()
+        return 0 if self._bank is None else len(self._bank)
+
+    def unload_factor_bank(self) -> None:
+        """Forget any loaded bank and reset the bank counters (test /
+        chaos hook): the next :meth:`ensure_factor_bank` re-attempts the
+        verified load, and the miss delegate restarts its solver ladder
+        (keeping its compiled programs)."""
+        self._bank = None
+        self._bank_lookup = None
+        self._bank_device = None
+        self._bank_load_attempted = False
+        self._bank_hits = 0
+        self._bank_misses = 0
+        self._bank_dropped_stale = 0
+        if self._bank_delegate is not None:
+            self._bank_delegate.solver = (
+                rpolicy.next_solver("precomputed") or "direct"
+            )
+
+    def bank_contains(self, u: int, i: int) -> bool:
+        return bool(self._bank_lookup) and (
+            (int(u), int(i)) in self._bank_lookup
+        )
+
+    def bank_stats(self) -> dict:
+        """Per-engine bank counters (bench/serve reporting)."""
+        return {
+            "entries": 0 if self._bank is None else len(self._bank),
+            "hits": int(self._bank_hits),
+            "misses": int(self._bank_misses),
+            "dropped_stale": int(self._bank_dropped_stale),
+        }
+
+    def _miss_delegate(self) -> "InfluenceEngine":
+        """Bank misses serve from a private engine at the next ladder
+        rung — config-identical except solver and cache_dir, so a miss
+        is bit-identical to a bank-less engine at that rung (the
+        fall-through fidelity contract factor_smoke pins)."""
+        if self._bank_delegate is None:
+            self._bank_delegate = InfluenceEngine(
+                self.model,
+                self._params_host,
+                RatingDataset(*self._train_host),
+                damping=self.damping,
+                solver=rpolicy.next_solver("precomputed") or "direct",
+                cg_maxiter=self.cg_maxiter,
+                cg_tol=self.cg_tol,
+                lissa_scale=self.lissa_scale,
+                lissa_depth=self.lissa_depth,
+                mesh=self.mesh,
+                cache_dir=None,
+                model_name=self.model_name,
+                pad_bucket=self.pad_bucket,
+                shard_tables=self._shard_tables,
+                hessian_mode=self.hessian_mode,
+                group_queries=self.group_queries,
+                pad_policy=self.pad_policy,
+                impl=self.impl,
+                flat_chunk=self.flat_chunk,
+                flat_accum=self.flat_accum,
+                row_features=self.row_features,
+                cpu_fallback=self.cpu_fallback,
+                query_bucket=self.query_bucket,
+            )
+        return self._bank_delegate
+
+    def _bank_serving_eligible(self) -> bool:
+        # the bank hit program is the flat prelude + a bank gather: it
+        # needs the same GN hooks and single-device geometry the flat
+        # path needs (meshes would shard a bank every device already
+        # holds — not worth a second dispatch layout)
+        return (
+            self.mesh is None
+            and self._bank_device is not None
+            and self.hessian_mode != "autodiff"
+            and not self.group_queries
+            and self.pad_policy == "batch"
+            and self.model.block_cross_const is not None
+            and self.model.block_reg_diag is not None
+        )
+
+    def _bank_fn(self, s_pad: int):
+        """Flat scoring program for bank hits: the ``_flat_fn`` prelude
+        (segment ids, one flat row gather, per-row block grads) with the
+        Hessian accumulation and batched solve replaced by one gather
+        from the device-resident bank plus a triangular solve (Cholesky
+        entries) / matvec (explicit-inverse entries) per query — the
+        O(1)-per-query hot path. Scores section is byte-for-byte the
+        flat program's, so hit results keep the packed layout and the
+        assembly/corruption seams downstream."""
+        use_feat = self._rowfeat is not None
+        key = ("flatbank", s_pad, use_feat)
+        if key in self._jitted:
+            return self._jitted[key]
+        from jax.scipy.linalg import cho_solve
+
+        model = self.model
+
+        def fn(params, train_x, train_y, postings, tx, rowfeat,
+               bfac, bknd, bidx):
+            T = tx.shape[0]
+            u, i = tx[:, 0], tx[:, 1]
+            uoff, urows, ioff, irows = postings
+            nu = uoff[u + 1] - uoff[u]
+            ni = ioff[i + 1] - ioff[i]
+            counts = nu + ni
+            off = jnp.concatenate(
+                [jnp.zeros((1,), jnp.int32),
+                 jnp.cumsum(counts, dtype=jnp.int32)]
+            )
+            total = off[-1]
+            s = jnp.arange(s_pad, dtype=jnp.int32)
+            t = jnp.clip(
+                jnp.cumsum(
+                    jnp.zeros((s_pad,), jnp.int32)
+                    .at[off[1:T]]
+                    .add(1, mode="drop")
+                ),
+                0, T - 1,
+            )
+            pos = s - off[t]
+            valid = s < total
+            ut, it = u[t], i[t]
+            cat_rows = jnp.concatenate([urows, irows])
+            base = jnp.where(
+                pos < nu[t],
+                uoff[ut] + pos,
+                urows.shape[0] + ioff[it] + pos - nu[t],
+            )
+            row = cat_rows[jnp.clip(base, 0, cat_rows.shape[0] - 1)]
+            wv = valid.astype(jnp.float32)
+            if use_feat:
+                feat = rowfeat[row]
+                g, e, _, _ = model.grads_from_row_features(feat, ut, it)
+            else:
+                rel_x = train_x[row]
+                rel_y = train_y[row]
+                if model.block_row_grads is not None:
+                    g = model.block_row_grads(params, ut, it, rel_x)
+                else:
+                    def one_g(xj, uu, ii):
+                        block0 = model.extract_block(params, uu, ii)
+
+                        def pred(bvec):
+                            block = model.unflatten_block(bvec, block0)
+                            return model.block_predict(
+                                params, block, uu, ii, xj[None, :]
+                            )[0]
+
+                        return jax.grad(pred)(model.flatten_block(block0))
+
+                    g = jax.vmap(one_g)(rel_x, ut, it)
+                e = model.predict(params, rel_x) - rel_y
+
+            v = jax.vmap(
+                lambda uu, ii, xj: G.block_prediction_grad(
+                    model, params, uu, ii, xj[None, :]
+                )
+            )(u, i, tx)
+            Fsel = bfac[bidx]  # (T, d, d): L or H^-1 per entry kind
+            ksel = bknd[bidx]
+            chol = jax.vmap(
+                lambda Lt, vt: cho_solve((Lt, True), vt)
+            )(Fsel, v)
+            mv = jnp.einsum("tij,tj->ti", Fsel, v)
+            ihvp = jnp.where((ksel == 1)[:, None], mv, chol)
+
+            n_t = jnp.maximum(counts.astype(jnp.float32), 1.0)
+            rdiag = model.block_reg_diag(params)
+            theta = jax.vmap(
+                lambda uu, ii: model.flatten_block(
+                    model.extract_block(params, uu, ii)
+                )
+            )(u, i)
+            reg_dot = jnp.sum(theta * rdiag[None] * ihvp, axis=1)
+            scores = wv * (
+                2.0 * e * jnp.einsum("sd,sd->s", g, ihvp[t]) + reg_dot[t]
+            ) / n_t[t]
+            return scores, ihvp, v
+
+        self._jitted[key] = jax.jit(fn)
+        return self._jitted[key]
+
+    def _query_bank_hits(self, points: np.ndarray, rows: np.ndarray,
+                         pad_to: int | None) -> InfluenceResult:
+        """One bank-hit dispatch (every point has a bank row). On a
+        classified device fault the points re-route through the miss
+        delegate — the O(1) tier must never cost availability."""
+        try:
+            inject.fire(sites.ENGINE_DISPATCH_FLAT)
+            counts = self.index.counts_batch(points)
+            tx_np = np.ascontiguousarray(np.asarray(points, np.int64))
+            ridx = np.asarray(rows, np.int64)
+            T = tx_np.shape[0]
+            t_pad = self._query_pad(T)
+            if t_pad > T:
+                # same trailing-pair duplication as _dispatch_flat: pad
+                # queries' flat rows land past `total` and slice away
+                tx_np = np.concatenate(
+                    [tx_np, np.repeat(tx_np[-1:], t_pad - T, axis=0)]
+                )
+                ridx = np.concatenate(
+                    [ridx, np.repeat(ridx[-1:], t_pad - T)]
+                )
+            s_pad = self._s_pad_for(int(counts.sum()))
+            bfac, bknd = self._bank_device
+            out = self._bank_fn(s_pad)(
+                self.params, self.train_x, self.train_y, self._postings,
+                jnp.asarray(tx_np, jnp.int32), self._rowfeat,
+                bfac, bknd, jnp.asarray(ridx, jnp.int32),
+            )
+            pad = bucketed_pad(
+                counts.max() if counts.size else 1, self.pad_bucket, pad_to
+            )
+            return self._assemble_packed(points, counts, out, pad)
+        except Exception as e:
+            if _classify_device_failure(e) is None:
+                raise
+            self._bank_hits -= len(points)
+            self._bank_misses += len(points)
+            return self._miss_delegate().query_batch(points, pad_to=pad_to)
+
+    def _merge_stream(self, test_points, hits, misses,
+                      pad_to: int | None) -> InfluenceResult:
+        """Stitch hit/miss sub-results back into stream order as one
+        packed result (``hits``/``misses`` are (positions, result))."""
+        counts = self.index.counts_batch(test_points)
+        T = len(test_points)
+        d = int(self.model.block_size)
+        ihvp = np.zeros((T, d), np.float32)
+        tg = np.zeros((T, d), np.float32)
+        off = np.concatenate(
+            [[0], np.cumsum(counts.astype(np.int64))]
+        )
+        packed = np.zeros(int(off[-1]), np.float32)
+        for idxs, res in (hits, misses):
+            for r, tpos in enumerate(idxs):
+                packed[off[tpos]: off[tpos + 1]] = res.scores_of(r)
+                ihvp[tpos] = res.ihvp[r]
+                tg[tpos] = res.test_grad[r]
+        pad = bucketed_pad(
+            counts.max() if counts.size else 1, self.pad_bucket, pad_to
+        )
+        return InfluenceResult(
+            counts=counts, ihvp=ihvp, test_grad=tg, packed=packed,
+            test_points=np.asarray(test_points), index=self.index, pad=pad,
+        )
+
+    def _query_precomputed(self, test_points: np.ndarray,
+                           pad_to: int | None) -> InfluenceResult:
+        """The ``precomputed`` rung: bank hits in one O(1)-per-query
+        dispatch, everything else through the delegate at the next
+        ladder rung (docs/design.md §16)."""
+        self.ensure_factor_bank()
+        T = test_points.shape[0]
+        if not self._bank_serving_eligible():
+            self._bank_misses += T
+            return self._miss_delegate().query_batch(
+                test_points, pad_to=pad_to
+            )
+        lut = self._bank_lookup
+        rows = np.fromiter(
+            (lut.get((int(u), int(i)), -1) for u, i in test_points),
+            np.int64, count=T,
+        )
+        hit = rows >= 0
+        nh = int(np.count_nonzero(hit))
+        self._bank_hits += nh
+        self._bank_misses += T - nh
+        if nh == T:
+            return self._query_bank_hits(test_points, rows, pad_to)
+        if nh == 0:
+            return self._miss_delegate().query_batch(
+                test_points, pad_to=pad_to
+            )
+        hi = np.flatnonzero(hit)
+        mi = np.flatnonzero(~hit)
+        res_h = self._query_bank_hits(test_points[hi], rows[hi], pad_to)
+        res_m = self._miss_delegate().query_batch(
+            test_points[mi], pad_to=pad_to
+        )
+        return self._merge_stream(test_points, (hi, res_h), (mi, res_m),
+                                  pad_to)
+
     # -- public API --------------------------------------------------------
     def query_batch(
         self,
@@ -1464,6 +1934,9 @@ class InfluenceEngine:
         if test_points.ndim == 1:
             test_points = test_points[None, :]
         T = test_points.shape[0]
+
+        if self.solver == "precomputed":
+            return self._query_precomputed(test_points, pad_to)
 
         if self.impl in ("auto", "flat") and self._flat_eligible():
             if self._wide_block_cap() and T > 32:

@@ -1,0 +1,446 @@
+"""Precomputed factorized iHVP tier: the factor bank.
+
+FIA's per-query Hessian is a tiny (2k+2 / 4k) block, yet the solver
+ladder (``lissa → schulz → cg → direct``) estimates and inverts it from
+scratch on every serve-cache miss. This module precomputes factorized
+inverse-Hessian blocks for HOT (user, item) pairs offline — following
+the low-rank factorization of LoRIF (arXiv:2601.21929) and the
+Schulz-iteration refinement of HyperINF (arXiv:2410.05090) — so a
+hot-path query collapses to one triangular solve / matvec inside the
+engine's existing flat dispatch (the ``precomputed`` solver rung;
+docs/design.md §16).
+
+The bank lifecycle is **select → factorize → publish → load →
+invalidate**:
+
+- :func:`select_hot_pairs` ranks users/items by interaction degree (the
+  serving hot set is degree-skewed by construction) and crosses the
+  heads into candidate pairs.
+- :func:`build_bank` computes the pairs' damped block Hessians in one
+  fused mega-batch dispatch (``InfluenceEngine.block_hessians``, the
+  flat program's ``hessian`` stage — AOT/mesh machinery included) and
+  factorizes them: batched Cholesky where the block is numerically PD,
+  a clamped-eigendecomposition inverse (low-rank + diagonal form) for
+  near-singular blocks, with optional Schulz polish of that fallback.
+- :func:`publish_bank` persists the bank through the artifact integrity
+  layer (fsync'd atomic npz + checksummed manifest, fault site
+  ``factor.publish``) under a config fingerprint binding model key,
+  block width, damping, and the exact train set.
+- :func:`load_bank` is a *verified* read: manifest checksum +
+  fingerprint first, then a per-entry ``dep_crc`` revalidation against
+  the CURRENT params/train state — a stale entry (any touched
+  parameter row or train row) is dropped at load, never served.
+- :func:`refresh_bank` is the surgical invalidation pass
+  (``FIAModel._invalidate``): after a params change it keeps exactly
+  the entries whose dependency digests still match (their Hessians are
+  provably unchanged, so their factors stay valid) and republishes the
+  survivors under the new fingerprint.
+
+``dep_crc`` is the per-entry params fingerprint: a digest over exactly
+the inputs the entry's Hessian and scores read — the parameter rows of
+every user/item appearing in the pair's related set, all non-embedding
+(global) parameters, the related rows' (x, y) bytes, and the solve
+constants. Anything else can change freely without touching the entry.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import struct
+
+import numpy as np
+
+from fia_tpu.reliability import artifacts, sites
+
+# Bump when the npz layout or dep_crc recipe changes: a bank written by
+# an older recipe must miss cleanly (fingerprint-mismatch), not serve
+# entries validated under different rules.
+BANK_VERSION = 1
+
+# Cholesky acceptance: min(diag(L)) must clear this fraction of
+# max(diag(L)), else the block is treated as near-singular and the
+# clamped-eigendecomposition fallback owns the entry.
+_RCOND = 1e-6
+
+KIND_CHOLESKY = 0  # factor holds L with H = L Lᵀ (lower)
+KIND_INVERSE = 1   # factor holds an explicit approximate H⁻¹
+
+
+class FactorBank:
+    """An immutable set of factorized block inverses keyed by (u, i).
+
+    Arrays (all host numpy, row ``n`` describes pair ``pairs[n]``):
+      pairs   (N, 2) int32 — the (user, item) pairs covered
+      kind    (N,)  uint8  — KIND_CHOLESKY or KIND_INVERSE
+      factor  (N, d, d) float32 — L or H⁻¹ per ``kind``
+      dep_crc (N,)  uint64 — per-entry dependency digest (see module doc)
+    """
+
+    def __init__(self, pairs, kind, factor, dep_crc):
+        self.pairs = np.ascontiguousarray(np.asarray(pairs, np.int32))
+        self.kind = np.ascontiguousarray(np.asarray(kind, np.uint8))
+        self.factor = np.ascontiguousarray(np.asarray(factor, np.float32))
+        self.dep_crc = np.ascontiguousarray(np.asarray(dep_crc, np.uint64))
+        n = len(self.pairs)
+        if not (len(self.kind) == len(self.factor) == len(self.dep_crc) == n):
+            raise ValueError("factor bank arrays disagree on entry count")
+
+    def __len__(self) -> int:
+        return len(self.pairs)
+
+    @property
+    def block_d(self) -> int:
+        return int(self.factor.shape[-1]) if len(self) else 0
+
+    def lookup(self) -> dict:
+        """Host hit-test map {(u, i): row}."""
+        return {
+            (int(u), int(i)): n for n, (u, i) in enumerate(self.pairs)
+        }
+
+    def take(self, mask: np.ndarray) -> "FactorBank":
+        mask = np.asarray(mask, bool)
+        return FactorBank(self.pairs[mask], self.kind[mask],
+                          self.factor[mask], self.dep_crc[mask])
+
+    @staticmethod
+    def empty(block_d: int) -> "FactorBank":
+        d = int(block_d)
+        return FactorBank(
+            np.zeros((0, 2), np.int32), np.zeros((0,), np.uint8),
+            np.zeros((0, d, d), np.float32), np.zeros((0,), np.uint64),
+        )
+
+
+def default_bank_path(cache_dir: str, model_name: str) -> str:
+    """Canonical on-disk location of a model's bank (the third serve
+    cache tier lives beside the per-query disk tier)."""
+    return os.path.join(cache_dir, "factor", f"{model_name}-bank.npz")
+
+
+def bank_fingerprint(model_name: str, block_d: int, damping: float,
+                     train_x: np.ndarray, train_y: np.ndarray) -> dict:
+    """Manifest fingerprint binding a bank to its config + train set.
+
+    Params freshness is deliberately NOT here — that is per-entry
+    ``dep_crc`` territory, so a params update can invalidate entries
+    surgically instead of voiding the whole artifact.
+    """
+    # normalize to RatingDataset's canonical dtypes so the digest is
+    # identical whether the caller holds raw arrays or engine state
+    x = np.ascontiguousarray(np.asarray(train_x, np.int32))
+    y = np.ascontiguousarray(np.asarray(train_y, np.float32))
+    return {
+        "kind": "factor-bank",
+        "version": BANK_VERSION,
+        "model_key": str(model_name),
+        "block_d": int(block_d),
+        "damping": repr(float(damping)),
+        "train_sha1": hashlib.sha1(x.tobytes() + y.tobytes()).hexdigest(),
+    }
+
+
+# -- hot-pair selection ----------------------------------------------------
+
+def select_hot_pairs(index, max_entries: int = 1024,
+                     top_users: int = 64, top_items: int = 64) -> np.ndarray:
+    """Candidate (u, i) pairs for the bank, hottest first.
+
+    Degree is the hotness signal the interaction index already holds:
+    rank users and items by interaction count, cross the two heads, and
+    score each pair by the product of its degrees (the classic
+    popularity-traffic proxy — a serve stream drawn from the empirical
+    interaction distribution hits these pairs first). Deterministic:
+    ties break by ascending id. Returns (N, 2) int32, N ≤ max_entries.
+    """
+    du = np.asarray(index.user_degrees(), np.int64)
+    di = np.asarray(index.item_degrees(), np.int64)
+    # stable argsort on negated degree: ties resolve by ascending id
+    users = np.argsort(-du, kind="stable")[: max(int(top_users), 0)]
+    items = np.argsort(-di, kind="stable")[: max(int(top_items), 0)]
+    users = users[du[users] > 0]
+    items = items[di[items] > 0]
+    if users.size == 0 or items.size == 0:
+        return np.zeros((0, 2), np.int32)
+    uu, ii = np.meshgrid(users, items, indexing="ij")
+    pairs = np.stack([uu.ravel(), ii.ravel()], axis=1)
+    score = du[pairs[:, 0]] * di[pairs[:, 1]]
+    order = np.lexsort((pairs[:, 1], pairs[:, 0], -score))
+    pairs = pairs[order][: max(int(max_entries), 0)]
+    return np.ascontiguousarray(pairs, np.int32)
+
+
+# -- per-entry dependency digests ------------------------------------------
+
+def _classify_leaves(model, params_host) -> list:
+    """Parameter leaves tagged by their keying axis.
+
+    A leaf whose leading dimension equals ``num_users`` is user-keyed
+    (its row u feeds only queries touching user u), ``num_items``
+    item-keyed; anything else — including the ambiguous case where the
+    leaf matches BOTH table sizes — is hashed per entry along every
+    matching axis (ambiguity costs digest bytes, never correctness).
+    Returns ``[(name, arr, tags)]`` sorted by the pytree key path.
+    """
+    import jax
+
+    leaves, _ = jax.tree_util.tree_flatten_with_path(params_host)
+    out = []
+    for path, leaf in leaves:
+        arr = np.asarray(leaf)
+        tags = set()
+        if arr.ndim >= 1 and arr.shape[0] == int(model.num_users):
+            tags.add("user")
+        if arr.ndim >= 1 and arr.shape[0] == int(model.num_items):
+            tags.add("item")
+        if not tags:
+            tags.add("global")
+        out.append((jax.tree_util.keystr(path), arr, tags))
+    out.sort(key=lambda t: t[0])
+    return out
+
+
+def dep_crcs(model, params_host, train_x, train_y, index,
+             pairs: np.ndarray, damping: float) -> np.ndarray:
+    """Per-pair dependency digests under the CURRENT params/train state.
+
+    Covers exactly what the (u, i) block Hessian and its scores read:
+    the parameter rows of every user/item id appearing in the pair's
+    related set (plus u and i themselves), every global leaf, the
+    related rows' (x, y) values in gather order, and the solve
+    constants (damping, block width, weight decay). An entry whose
+    stored digest equals the fresh one is provably untouched by
+    whatever changed — the basis of surgical invalidation.
+    """
+    pairs = np.asarray(pairs, np.int64)
+    # same dtype normalization as bank_fingerprint: digest-stable across
+    # raw-array and RatingDataset-canonicalized callers
+    x = np.ascontiguousarray(np.asarray(train_x, np.int32))
+    y = np.ascontiguousarray(np.asarray(train_y, np.float32))
+    leaves = _classify_leaves(model, params_host)
+
+    seed = hashlib.blake2b(digest_size=16)
+    seed.update(struct.pack("<iid", int(model.block_size), BANK_VERSION,
+                            float(damping)))
+    seed.update(struct.pack("<d", float(model.weight_decay)))
+    for name, arr, tags in leaves:
+        if "global" in tags:
+            seed.update(name.encode())
+            seed.update(np.ascontiguousarray(arr).tobytes())
+    seed_digest = seed.digest()
+
+    out = np.empty(len(pairs), np.uint64)
+    for n, (u, i) in enumerate(pairs):
+        u, i = int(u), int(i)
+        urows = np.asarray(index.rows_of_user(u), np.int64)
+        irows = np.asarray(index.rows_of_item(i), np.int64)
+        rel = np.concatenate([urows, irows])
+        users = np.unique(np.concatenate([[u], x[irows, 0]]))
+        items = np.unique(np.concatenate([[i], x[urows, 1]]))
+        h = hashlib.blake2b(digest_size=8)
+        h.update(seed_digest)
+        h.update(struct.pack("<qq", u, i))
+        for name, arr, tags in leaves:
+            if "user" in tags:
+                h.update(np.ascontiguousarray(arr[users]).tobytes())
+            if "item" in tags:
+                h.update(np.ascontiguousarray(arr[items]).tobytes())
+        h.update(rel.tobytes())
+        h.update(np.ascontiguousarray(x[rel]).tobytes())
+        h.update(np.ascontiguousarray(y[rel]).tobytes())
+        out[n] = np.uint64(
+            int.from_bytes(h.digest(), "little", signed=False)
+        )
+    return out
+
+
+# -- factorization ---------------------------------------------------------
+
+def factorize(H, schulz_polish: bool = False, schulz_iters: int = 8,
+              rcond: float = _RCOND):
+    """Factorize a batch of damped block Hessians.
+
+    Batched Cholesky first — H is damped Gauss-Newton, PD at any
+    well-trained optimum, and ``cho_solve`` at query time is the
+    cheapest exact solve there is. Rows where the factorization fails
+    numerically (non-finite L, or a diagonal spread past ``rcond`` —
+    the away-from-optimum indefinite case solve_direct's LU guards
+    against) fall back to a clamped eigendecomposition: eigenvalue
+    MAGNITUDES floored at ``rcond·|λ|_max`` (signs preserved — the
+    ladder's direct rung LU-solves the indefinite system as-is) and
+    inverted, i.e. a low-rank (well-conditioned eigenspace) +
+    diagonal-floor inverse. With
+    ``schulz_polish`` the fallback inverse is refined by best-iterate
+    Newton–Schulz steps X ← X(2I − HX) (HyperINF, arXiv:2410.05090),
+    which sharpens the clamped modes where H was merely ill-conditioned
+    rather than truly singular.
+
+    Returns ``(kind (N,) uint8, factor (N, d, d) float32)`` as numpy.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    H = jnp.asarray(H, jnp.float32)
+    if H.ndim == 2:
+        H = H[None]
+    d = H.shape[-1]
+    eye = jnp.eye(d, dtype=jnp.float32)
+
+    L = jnp.linalg.cholesky(H)
+    diag = jnp.diagonal(L, axis1=-2, axis2=-1)
+    ok = jnp.all(jnp.isfinite(L), axis=(-2, -1)) & (
+        jnp.min(diag, axis=-1)
+        > rcond * jnp.maximum(jnp.max(diag, axis=-1), 1e-30)
+    )
+
+    # sign-PRESERVING magnitude floor: away from the optimum the block
+    # Hessian is legitimately indefinite and solve_direct answers with
+    # a plain LU solve of that indefinite system — flipping a healthy
+    # negative eigenvalue positive would diverge from the ladder's
+    # ground truth. Only near-zero magnitudes get regularized.
+    w, V = jnp.linalg.eigh(H)
+    aw = jnp.abs(w)
+    floor = jnp.maximum(
+        rcond * jnp.max(aw, axis=-1, keepdims=True), 1e-12
+    )
+    wc = jnp.where(w < 0, -1.0, 1.0) * jnp.maximum(aw, floor)
+    Hinv = jnp.einsum("nij,nj,nkj->nik", V, 1.0 / wc, V)
+
+    if schulz_polish and int(schulz_iters) > 0:
+        mm = lambda a, b: jnp.matmul(
+            a, b, precision=jax.lax.Precision.HIGHEST
+        )
+
+        def resid(X):
+            R = eye[None] - mm(H, X)
+            return jnp.sqrt(jnp.mean(jnp.square(R), axis=(-2, -1)))
+
+        best, r_best = Hinv, resid(Hinv)
+        X = Hinv
+        for _ in range(int(schulz_iters)):
+            X = mm(X, 2.0 * eye[None] - mm(H, X))
+            r = resid(X)
+            better = jnp.isfinite(r) & (r < r_best)
+            best = jnp.where(better[:, None, None], X, best)
+            r_best = jnp.where(better, r, r_best)
+        Hinv = best
+
+    factor = jnp.where(ok[:, None, None], jnp.nan_to_num(L), Hinv)
+    kind = jnp.where(ok, KIND_CHOLESKY, KIND_INVERSE).astype(jnp.uint8)
+    return (np.asarray(jax.device_get(kind), np.uint8),
+            np.asarray(jax.device_get(factor), np.float32))
+
+
+# -- build / publish / load / refresh --------------------------------------
+
+def build_bank(engine, pairs: np.ndarray, batch_queries: int = 512,
+               schulz_polish: bool = False) -> FactorBank:
+    """Factorize ``pairs``' damped block Hessians into a bank.
+
+    The Hessians come from ONE fused mega-batch dispatch per
+    ``batch_queries`` chunk (:meth:`InfluenceEngine.block_hessians`,
+    the flat program's ``hessian`` stage — mesh-sharded when the engine
+    carries a mesh), so the offline pass rides the same AOT'd machinery
+    as online queries.
+    """
+    pairs = np.asarray(pairs, np.int64)
+    if pairs.size == 0:
+        return FactorBank.empty(engine.model.block_size)
+    H = engine.block_hessians(pairs, batch_queries=batch_queries)
+    kind, factor = factorize(H, schulz_polish=schulz_polish)
+    crc = dep_crcs(engine.model, engine._params_host,
+                   engine._train_host[0], engine._train_host[1],
+                   engine.index, pairs, engine.damping)
+    return FactorBank(pairs, kind, factor, crc)
+
+
+def publish_bank(bank: FactorBank, path: str, fingerprint: dict) -> str:
+    """Durably publish a bank through the artifact integrity layer
+    (fault site ``factor.publish``; torn/bitflip/stale-manifest damage
+    is detected and quarantined on the next verified load)."""
+    return artifacts.publish_npz(
+        path,
+        {
+            "pairs": bank.pairs,
+            "kind": bank.kind,
+            "factor": bank.factor,
+            "dep_crc": bank.dep_crc,
+        },
+        fingerprint=fingerprint,
+        site=sites.FACTOR_PUBLISH,
+    )
+
+
+def _bank_from_raw(raw: dict, path: str) -> FactorBank:
+    try:
+        return FactorBank(raw["pairs"], raw["kind"], raw["factor"],
+                          raw["dep_crc"])
+    except (KeyError, ValueError) as e:
+        # checksum passed but the payload is not a bank (foreign writer
+        # under our name): quarantine like any unreadable artifact
+        artifacts.quarantine(path, f"bank-malformed: {e}")
+        raise artifacts.ArtifactIntegrityError(
+            path, "unreadable", f"bank-malformed: {e}"
+        )
+
+
+def load_bank(path: str, engine) -> tuple[FactorBank, int]:
+    """Verified bank load against the CURRENT engine state.
+
+    Integrity first (checksum + config/train fingerprint; corrupt files
+    quarantine as ``*.corrupt`` and read as a miss), then the per-entry
+    ``dep_crc`` revalidation: entries whose digests no longer match the
+    live params/train state are dropped HERE — a stale entry under a
+    new params fingerprint is structurally unservable. Returns
+    ``(bank_of_survivors, n_dropped)``; raises
+    :class:`~fia_tpu.reliability.artifacts.ArtifactIntegrityError` on
+    integrity failure (callers treat it as "no bank").
+    """
+    fp = bank_fingerprint(engine.model_name, engine.model.block_size,
+                          engine.damping, *engine._train_host)
+    raw = artifacts.load_npz(path, expected_fingerprint=fp,
+                             require_manifest=True)
+    bank = _bank_from_raw(raw, path)
+    if len(bank) == 0:
+        return bank, 0
+    fresh = dep_crcs(engine.model, engine._params_host,
+                     engine._train_host[0], engine._train_host[1],
+                     engine.index, bank.pairs, engine.damping)
+    keep = fresh == bank.dep_crc
+    return bank.take(keep), int(np.count_nonzero(~keep))
+
+
+def refresh_bank(model, params_host, train_x, train_y, index, damping,
+                 path: str, model_name: str) -> dict:
+    """Surgical invalidation after a params/train change.
+
+    Re-digests every published entry under the NEW state and
+    republishes exactly the survivors (their inputs are unchanged, so
+    their factors are still the factors of the current Hessians — no
+    recompute needed) under the new fingerprint. Touched entries are
+    dropped. Returns ``{"kept": int, "dropped": int}``; a missing or
+    corrupt bank is a no-op (corruption quarantines as usual).
+    """
+    if not os.path.exists(path):
+        return {"kept": 0, "dropped": 0}
+    try:
+        # integrity-only read: the OLD fingerprint is unknowable here
+        # (that is the point of the refresh), dep_crc does the params
+        # half of the validation below
+        raw = artifacts.load_npz(path, require_manifest=True)
+        bank = _bank_from_raw(raw, path)
+    except artifacts.ArtifactIntegrityError:
+        return {"kept": 0, "dropped": 0}
+    if len(bank):
+        fresh = dep_crcs(model, params_host, train_x, train_y, index,
+                         bank.pairs, damping)
+        keep = fresh == bank.dep_crc
+        dropped = int(np.count_nonzero(~keep))
+        bank = bank.take(keep)
+    else:
+        dropped = 0
+    fp = bank_fingerprint(model_name, model.block_size, damping,
+                          train_x, train_y)
+    publish_bank(bank, path, fp)
+    return {"kept": len(bank), "dropped": dropped}

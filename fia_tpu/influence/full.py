@@ -52,6 +52,17 @@ class FullInfluenceEngine:
         mesh: Mesh | None = None,
         residual_guard: float | None = None,
     ):
+        if solver not in rpolicy.FULL_SOLVERS:
+            # the factor bank holds (2k+2)-wide BLOCK inverses; the
+            # full-parameter Hessian it would need here cannot even be
+            # materialised, so 'precomputed' (and 'direct'/'schulz')
+            # must be resolved away via resolve_solver(...,
+            # supported=FULL_SOLVERS) before reaching this constructor
+            raise ValueError(
+                f"unknown solver {solver!r} for the full-parameter "
+                f"engine (supported: {rpolicy.FULL_SOLVERS}); route "
+                "requests through policy.resolve_solver"
+            )
         self.model = model
         self.damping = float(damping)
         self.solver = solver

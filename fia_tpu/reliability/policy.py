@@ -203,7 +203,12 @@ class RetryPolicy:
 # engine can always fall back to materialising the tiny block Hessian
 # and LU-solving it exactly; the full-parameter engine cannot, so its
 # ladder ends at CG (whose best-iterate freeze never diverges).
-QUERY_SOLVER_FALLBACK = {"lissa": "cg", "schulz": "direct", "cg": "direct"}
+# ``precomputed`` sits ahead of the ladder: a bank hit is one
+# triangular-solve/matvec, and ANY trouble — missing bank entry, stale
+# fingerprint, damaged artifact, NaN payload — falls through to the
+# estimated rungs, which serve the query from scratch.
+QUERY_SOLVER_FALLBACK = {"precomputed": "lissa", "lissa": "cg",
+                         "schulz": "direct", "cg": "direct"}
 FULL_SOLVER_FALLBACK = {"lissa": "cg"}
 
 
@@ -215,8 +220,10 @@ def next_solver(
     return fallback.get(current)
 
 
-# Solver names each engine accepts (ladder-ordered robust-last).
-BLOCK_SOLVERS = ("lissa", "schulz", "cg", "direct")
+# Solver names each engine accepts (ladder-ordered robust-last). The
+# full-parameter engine has no block bank, so ``precomputed`` requested
+# there walks the ladder down to ``lissa`` via resolve_solver.
+BLOCK_SOLVERS = ("precomputed", "lissa", "schulz", "cg", "direct")
 FULL_SOLVERS = ("lissa", "cg")
 
 

@@ -33,6 +33,10 @@ ENGINE_DISPATCH_FLAT = "engine.dispatch_flat"
 ENGINE_DISPATCH_PADDED = "engine.dispatch_padded"
 ENGINE_SOLVE = "engine.solve"
 ENGINE_CACHE_PUBLISH = "engine.cache_publish"
+ENGINE_FACTOR_LOAD = "engine.factor_load"
+
+# -- factor bank (precomputed iHVP tier) -------------------------------
+FACTOR_PUBLISH = "factor.publish"
 
 # -- full-parameter engine ---------------------------------------------
 FULL_SOLVE = "full.solve"
@@ -62,6 +66,8 @@ ALL_SITES = frozenset({
     ENGINE_DISPATCH_PADDED,
     ENGINE_SOLVE,
     ENGINE_CACHE_PUBLISH,
+    ENGINE_FACTOR_LOAD,
+    FACTOR_PUBLISH,
     FULL_SOLVE,
     TRAINER_EPOCH,
     TRAINER_LOO_SEGMENT,

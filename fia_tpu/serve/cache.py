@@ -1,6 +1,6 @@
 """Hot-block caching for the serving layer.
 
-Two tiers above the engine's device compute:
+Three tiers above the engine's from-scratch device compute:
 
 - :class:`HotBlockCache` — a bounded in-memory LRU over per-(user,
   item) solved blocks (iHVP, test-side vector, unpadded scores). Keys
@@ -14,6 +14,14 @@ Two tiers above the engine's device compute:
   atomic publish with a checksummed manifest carrying the same
   fingerprint, verify-on-read with quarantine-to-``*.corrupt`` on
   damage — a torn or bit-rotted entry is a clean miss, never poison.
+- the factor-bank tier — below both: a miss that reaches the device on
+  a ``solver='precomputed'`` engine is answered from the preloaded
+  factorized block-inverse bank (one triangular-solve/matvec) when the
+  (user, item) pair is banked, falling through the solver ladder
+  otherwise. The bank itself is engine state
+  (:meth:`~fia_tpu.influence.engine.InfluenceEngine.load_factor_bank`);
+  this layer only labels the tier and counts the hits
+  (``CacheStats.hits_bank``).
 
 Entry payloads are plain numpy arrays, write-protected before they
 enter the hot tier so a consumer mutating a response cannot corrupt
@@ -35,6 +43,7 @@ from fia_tpu.reliability import sites
 class CacheStats:
     hits_hot: int = 0
     hits_disk: int = 0
+    hits_bank: int = 0  # factor-bank (precomputed-tier) dispatch hits
     misses: int = 0
     evictions: int = 0
     invalidations: int = 0

@@ -17,10 +17,14 @@ import numpy as np
 # Cache tiers a response can be served from. ``compute`` = this request
 # triggered (or rode) a device dispatch this drain; ``hot`` = in-memory
 # LRU hit (including duplicates coalesced within one drain); ``disk`` =
-# verified on-disk entry promoted into the hot tier.
+# verified on-disk entry promoted into the hot tier; ``precomputed`` =
+# the dispatch was an O(1) factor-bank hit (solver='precomputed'):
+# device work happened, but it was one triangular-solve/matvec against
+# the preloaded bank rather than a from-scratch ladder solve.
 TIER_COMPUTE = "compute"
 TIER_HOT = "hot"
 TIER_DISK = "disk"
+TIER_PRECOMPUTED = "precomputed"
 
 STATUS_OK = "ok"
 STATUS_REJECTED = "rejected"

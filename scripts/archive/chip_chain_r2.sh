@@ -2,7 +2,7 @@
 # Round-2 chip job chain: waits for the in-flight MF RQ1 (pid $1), then
 # runs the remaining single-occupancy chip jobs sequentially.
 set -u
-cd "$(dirname "$0")/.."
+cd "$(dirname "$0")/../.."
 
 if [ $# -ge 1 ]; then
   while kill -0 "$1" 2>/dev/null; do sleep 60; done

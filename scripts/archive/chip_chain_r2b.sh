@@ -2,7 +2,7 @@
 # Round-2 chip chain, part B: RQ2 re-measures on the calibrated stream,
 # the fixed-pairing impl A/B, and a full bench. Waits for part A (pid $1).
 set -u
-cd "$(dirname "$0")/.."
+cd "$(dirname "$0")/../.."
 
 if [ $# -ge 1 ]; then
   while kill -0 "$1" 2>/dev/null; do sleep 60; done

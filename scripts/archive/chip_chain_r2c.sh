@@ -5,7 +5,7 @@
 # tunnel client blocks forever, observed 18:27), the job is killed, the
 # tunnel re-probed, and the job retried once.
 set -u
-cd "$(dirname "$0")/.."
+cd "$(dirname "$0")/../.."
 STALL_S=${STALL_S:-1500}
 
 wait_tunnel() {

@@ -14,7 +14,7 @@
 # the launcher therefore runs it AS chip_chain_r3.sh (copied over after
 # the original exits).
 set -u
-cd "$(dirname "$0")/.."
+cd "$(dirname "$0")/../.."
 STALL_S=${STALL_S:-1500}
 DEADLINE_EPOCH=$(date -d "2026-07-31 20:15:00 UTC" +%s)
 

@@ -6,7 +6,7 @@
 # — the engine now memory-adaptively chunks the padded path, so the A/B
 # completes and additionally measures the padded impl's chunking cost.
 set -u
-cd "$(dirname "$0")/.."
+cd "$(dirname "$0")/../.."
 
 exec 9> output/.chain_r3b.lock
 flock -n 9 || exit 0

@@ -8,7 +8,7 @@
 # num_test 8 so the cal2 fidelity matrix's pooled r carries 4x the
 # sample. Protocol match: reference RQ1.sh rows, widened sample only.
 set -u
-cd "$(dirname "$0")/.."
+cd "$(dirname "$0")/../.."
 STALL_S=${STALL_S:-1500}
 DEADLINE_EPOCH=$(date -d "2026-07-31 20:15:00 UTC" +%s)
 

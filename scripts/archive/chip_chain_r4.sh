@@ -7,7 +7,7 @@
 # Deadline 07:30 UTC Aug 1; scripts/round_end_guard_r4.sh kills
 # stragglers at 07:45.
 set -u
-cd "$(dirname "$0")/.."
+cd "$(dirname "$0")/../.."
 STALL_S=${STALL_S:-1500}
 DEADLINE_EPOCH=$(date -d "2026-08-01 07:30:00 UTC" +%s)
 

@@ -4,7 +4,7 @@
 # last. Deadline 07:30 UTC Aug 1 (round_end_guard_r4.sh kills at 07:45
 # so the driver's bench gets a free chip).
 set -u
-cd "$(dirname "$0")/.."
+cd "$(dirname "$0")/../.."
 STALL_S=${STALL_S:-1500}
 DEADLINE_EPOCH=$(date -d "2026-08-01 07:30:00 UTC" +%s)
 

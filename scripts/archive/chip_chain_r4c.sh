@@ -5,7 +5,7 @@
 # "TPU backend error" variant, restart backoff, bounded halving), a
 # longer padded-NCF descent, and a bench re-preview on a free host.
 set -u
-cd "$(dirname "$0")/.."
+cd "$(dirname "$0")/../.."
 STALL_S=${STALL_S:-1500}
 DEADLINE_EPOCH=$(date -d "2026-08-01 07:30:00 UTC" +%s)
 

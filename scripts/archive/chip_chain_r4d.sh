@@ -7,7 +7,7 @@
 # (Historical note: ran with its own inlined harness copy; later
 # chains source scripts/chain_lib.sh instead.)
 set -u
-cd "$(dirname "$0")/.."
+cd "$(dirname "$0")/../.."
 STALL_S=${STALL_S:-1500}
 DEADLINE_EPOCH=$(date -d "2026-08-01 07:30:00 UTC" +%s)
 

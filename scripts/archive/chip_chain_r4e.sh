@@ -9,10 +9,10 @@
 # banks usable points. Deadline 07:00 UTC with the 07:45 guard behind
 # it; the driver's bench needs the chip by ~09:00.
 set -u
-cd "$(dirname "$0")/.."
+cd "$(dirname "$0")/../.."
 CHAIN_TAG=chainR4e
 DEADLINE_EPOCH=$(date -d "2026-08-01 07:00:00 UTC" +%s)
-source "$(dirname "$0")/chain_lib.sh"
+source scripts/chain_lib.sh
 
 echo "chainR4e: $(date) tier 5 starting" >> output/chain.log
 wait_tunnel

@@ -5,10 +5,10 @@
 # from 2 to 4 sampled test points at the reference's own 18k x 4
 # budget (~35 min/point measured from tier 5's chunk rate).
 set -u
-cd "$(dirname "$0")/.."
+cd "$(dirname "$0")/../.."
 CHAIN_TAG=chainR4f
 DEADLINE_EPOCH=$(date -d "2026-08-01 06:45:00 UTC" +%s)
-source "$(dirname "$0")/chain_lib.sh"
+source scripts/chain_lib.sh
 
 until grep -q "^chainR4e: .* tier 5 done" output/chain.log; do
   past_deadline && exit 0

@@ -11,10 +11,10 @@
 # live in chip_chain_r4h.sh. Each job is idempotent via the banked()
 # marker, so this script can be re-launched after a tunnel outage.
 set -u
-cd "$(dirname "$0")/.."
+cd "$(dirname "$0")/../.."
 CHAIN_TAG=chainR4g
 DEADLINE_EPOCH=$(date -d "2026-08-01 20:30:00 UTC" +%s)
-source "$(dirname "$0")/chain_lib.sh"
+source scripts/chain_lib.sh
 
 echo "chainR4g: $(date) tier 7 starting" >> output/chain.log
 wait_tunnel

@@ -10,10 +10,10 @@
 # complete, so a deadline cut still leaves banked points (the r4f
 # precedent).
 set -u
-cd "$(dirname "$0")/.."
+cd "$(dirname "$0")/../.."
 CHAIN_TAG=chainR4h
 DEADLINE_EPOCH=$(date -d "2026-08-01 20:30:00 UTC" +%s)
-source "$(dirname "$0")/chain_lib.sh"
+source scripts/chain_lib.sh
 
 until grep -q "^chainR4g: .* tier 7 done" output/chain.log; do
   past_deadline && exit 0

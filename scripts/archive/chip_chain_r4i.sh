@@ -6,10 +6,10 @@
 # cost-model artifact) and a final chip bench preview close to what
 # the driver's BENCH_r04 will run.
 set -u
-cd "$(dirname "$0")/.."
+cd "$(dirname "$0")/../.."
 CHAIN_TAG=chainR4i
 DEADLINE_EPOCH=$(date -d "2026-08-01 20:30:00 UTC" +%s)
-source "$(dirname "$0")/chain_lib.sh"
+source scripts/chain_lib.sh
 
 until grep -q "^chainR4h: .* tier 8 done" output/chain.log; do
   past_deadline && exit 0

@@ -6,10 +6,10 @@
 # device program dominates. Measures the flat path at 512/1024/2048-
 # query dispatches (the bench's 256 stays the cross-round comparable).
 set -u
-cd "$(dirname "$0")/.."
+cd "$(dirname "$0")/../.."
 CHAIN_TAG=chainR4j
 DEADLINE_EPOCH=$(date -d "2026-08-01 20:30:00 UTC" +%s)
-source "$(dirname "$0")/chain_lib.sh"
+source scripts/chain_lib.sh
 
 until grep -q "^chainR4i: .* tier 9 done" output/chain.log; do
   past_deadline && exit 0

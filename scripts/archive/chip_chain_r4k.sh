@@ -9,10 +9,10 @@
 # leaves usable points. The --test_indices run auto-diverts its npz
 # (cli/rq1.artifact_path) and merges via scripts/merge_rq1.py.
 set -u
-cd "$(dirname "$0")/.."
+cd "$(dirname "$0")/../.."
 CHAIN_TAG=chainR4k
 DEADLINE_EPOCH=$(date -d "2026-08-01 20:30:00 UTC" +%s)
-source "$(dirname "$0")/chain_lib.sh"
+source scripts/chain_lib.sh
 
 until grep -q "^chainR4j: .* tier 10 done" output/chain.log; do
   past_deadline && exit 0

@@ -19,10 +19,10 @@
 # Per-point values bank into logs + npz as each point completes, so a
 # deadline cut still leaves usable points.
 set -u
-cd "$(dirname "$0")/.."
+cd "$(dirname "$0")/../.."
 CHAIN_TAG=chainR5a
 DEADLINE_EPOCH=$(date -d "2026-08-02 08:30:00 UTC" +%s)
-source "$(dirname "$0")/chain_lib.sh"
+source scripts/chain_lib.sh
 
 echo "chainR5a: $(date) tier 12 starting" >> output/chain.log
 wait_tunnel

@@ -13,10 +13,10 @@
 #  3. roofline --trace — the jax.profiler-through-the-tunnel attempt
 #     VERDICT asked for; outcome (trace or failure) recorded either way.
 set -u
-cd "$(dirname "$0")/.."
+cd "$(dirname "$0")/../.."
 CHAIN_TAG=chainR5b
 DEADLINE_EPOCH=$(date -d "2026-08-02 08:30:00 UTC" +%s)
-source "$(dirname "$0")/chain_lib.sh"
+source scripts/chain_lib.sh
 
 until grep -q "^chainR5a: .* mfml full n8 done" output/chain.log; do
   past_deadline && exit 0

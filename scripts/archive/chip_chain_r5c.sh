@@ -5,10 +5,10 @@
 # chunks of 32 x 10.4 min at 24k steps): whatever fits before the
 # 08:30 deadline banks per point; the rest is the documented residue.
 set -u
-cd "$(dirname "$0")/.."
+cd "$(dirname "$0")/../.."
 CHAIN_TAG=chainR5c
 DEADLINE_EPOCH=$(date -d "2026-08-02 08:30:00 UTC" +%s)
-source "$(dirname "$0")/chain_lib.sh"
+source scripts/chain_lib.sh
 
 until grep -q "^chainR5a: .* tier 12 done" output/chain.log; do
   past_deadline && exit 0

@@ -9,7 +9,7 @@
 # re-evaluated before EVERY job, so a tunnel recovery mid-hedge stops
 # further launches (an already-running job is allowed to finish).
 set -u
-cd "$(dirname "$0")/.."
+cd "$(dirname "$0")/../.."
 export JAX_PLATFORMS=cpu
 HDIR=output/cpu_hedge
 mkdir -p "$HDIR"

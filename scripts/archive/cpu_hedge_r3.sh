@@ -8,7 +8,7 @@
 # both exist; fidelity/agreement numbers are backend-independent, so a
 # CPU row is a valid (if slower-to-produce) measurement.
 set -u
-cd "$(dirname "$0")/.."
+cd "$(dirname "$0")/../.."
 export JAX_PLATFORMS=cpu
 # own artifact/checkpoint namespace: the chip chain writes the same
 # RQ1-<model>-<dataset>.npz and checkpoint filenames under output/, and

@@ -9,7 +9,7 @@
 # Round 4 started ~21:09 UTC Jul 31 + 12h => ends ~09:09 UTC Aug 1;
 # the guard fires at 07:45 for margin (tunnel flakiness, compile time).
 set -u
-cd "$(dirname "$0")/.."
+cd "$(dirname "$0")/../.."
 
 exec 9> output/.endguard_r4.lock
 flock -n 9 || exit 0

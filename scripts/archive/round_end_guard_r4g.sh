@@ -23,7 +23,7 @@
 # "python ..."; the driver starts with "claude", the relay with
 # "python3 -u /root/.relay.py", and neither can match below.
 set -u
-cd "$(dirname "$0")/.."
+cd "$(dirname "$0")/../.."
 
 exec 9> output/.endguard_r4g.lock
 flock -n 9 || exit 0

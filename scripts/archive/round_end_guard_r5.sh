@@ -9,7 +9,7 @@
 # driver's argv0 is "claude" (its quoted prompt contains these
 # patterns — a bare pgrep -f killed a builder session in r4).
 set -u
-cd "$(dirname "$0")/.."
+cd "$(dirname "$0")/../.."
 
 exec 9> output/.endguard_r5.lock
 flock -n 9 || exit 0

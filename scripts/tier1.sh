@@ -24,14 +24,24 @@ bash scripts/chaos_smoke.sh || {
   echo "chaos-smoke FAILED (see repro path above; run make chaos-smoke)"
   exit 1
 }
+# Factor smoke, FATAL: the precomputed solver tier's CI gate — build a
+# tiny bank, verified artifact load, bank hits at Spearman >= 0.999 vs
+# the direct solver, bitwise miss fall-through (docs/design.md §16).
+bash scripts/factor_smoke.sh || {
+  echo "factor-smoke FAILED (run make factor-smoke)"
+  exit 1
+}
+# Multichip smoke, FATAL (green since PR 7): the sharded dispatch sweep
+# on 8 virtual CPU devices — zero steady-state compiles per device
+# count, mesh serving bit-identical to single-device.
+bash scripts/multichip_smoke.sh || {
+  echo "multichip-smoke FAILED (run make multichip-smoke)"
+  exit 1
+}
 # Serving smoke next, NON-fatal: the pinned tier-1 verdict below stays
 # exactly the ROADMAP.md pytest command, the smoke just surfaces
 # serving regressions in the same log.
 bash scripts/serve_smoke.sh || echo "serve-smoke FAILED (non-fatal here; run make serve-smoke)"
-# Multichip smoke, NON-fatal for the same reason: the sharded dispatch
-# sweep on 8 virtual CPU devices (zero steady-state compiles per device
-# count, mesh serving bit-identical to single-device).
-bash scripts/multichip_smoke.sh || echo "multichip-smoke FAILED (non-fatal here; run make multichip-smoke)"
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
   -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
   -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log

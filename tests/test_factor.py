@@ -1,0 +1,318 @@
+"""Factor bank: the precomputed solver tier (docs/design.md §16).
+
+Pins the tier's four contracts:
+  - fidelity: bank-served scores at Spearman >= 0.999 vs the exact
+    direct solver on the RQ1 protocol slice
+  - availability: misses and damaged/stale banks fall through the
+    solver ladder bitwise-identically to a bank-less engine
+  - the ladder itself: ``resolve_solver`` rung semantics and the full
+    ``precomputed -> lissa -> cg -> direct`` escalation under injected
+    per-rung NaN payloads
+  - surgical invalidation: a params update drops exactly the touched
+    entries (per-entry dep_crc), and a stale bank under new params is
+    never served
+"""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from fia_tpu.data.dataset import RatingDataset
+from fia_tpu.eval.metrics import spearman
+from fia_tpu.influence import factor as fbank
+from fia_tpu.influence.engine import InfluenceEngine
+from fia_tpu.influence.full import FullInfluenceEngine
+from fia_tpu.models import MF
+from fia_tpu.reliability import inject
+from fia_tpu.reliability import policy as rpolicy
+from fia_tpu.reliability import sites
+
+U, I, K = 30, 20, 4
+WD, DAMP = 1e-2, 1e-3
+NAME = "tfac"
+DEPTH = 30  # keeps the tiny random-init blocks inside LiSSA's horizon
+
+
+def _setup(seed=0, n=600):
+    rng = np.random.default_rng(seed)
+    x = np.stack([rng.integers(0, U, n), rng.integers(0, I, n)],
+                 axis=1).astype(np.int32)
+    y = rng.integers(1, 6, n).astype(np.float32)
+    return MF(U, I, K, WD), RatingDataset(x, y)
+
+
+def _engine(model, params, train, tmp_path=None, solver="precomputed"):
+    return InfluenceEngine(
+        model, params, train, damping=DAMP, solver=solver,
+        cache_dir=str(tmp_path) if tmp_path is not None else None,
+        model_name=NAME, lissa_depth=DEPTH,
+    )
+
+
+def _publish(tmp_path, model, params, train, entries=24):
+    """Build + publish a bank; returns (builder_engine, bank, path)."""
+    builder = _engine(model, params, train, tmp_path, solver="direct")
+    pairs = fbank.select_hot_pairs(builder.index, max_entries=entries,
+                                   top_users=6, top_items=6)
+    bank = fbank.build_bank(builder, pairs, batch_queries=entries)
+    fp = fbank.bank_fingerprint(NAME, model.block_size, DAMP,
+                                *builder._train_host)
+    path = builder.factor_bank_path()
+    fbank.publish_bank(bank, path, fp)
+    return builder, bank, path
+
+
+def _miss_pairs(train, bank, k=3):
+    banked = {tuple(p) for p in bank.pairs.tolist()}
+    out = [
+        (int(u), int(i))
+        for u, i in zip(train.x[:, 0], train.x[:, 1])
+        if (int(u), int(i)) not in banked
+    ]
+    assert len(out) >= k
+    return np.asarray(out[:k], np.int64)
+
+
+class TestResolveSolver:
+    def test_unknown_name_bottoms_out_at_most_robust(self):
+        # no ladder edge from an unknown name: resolve lands on the
+        # most robust supported rung instead of raising deep in a ctor
+        assert rpolicy.resolve_solver("frobnicate") == "direct"
+        assert (rpolicy.resolve_solver("frobnicate",
+                                       supported=rpolicy.FULL_SOLVERS)
+                == "cg")
+
+    def test_none_resolves_to_default(self):
+        assert rpolicy.resolve_solver(None, default="lissa") == "lissa"
+
+    def test_precomputed_on_full_engine_degrades_to_lissa(self):
+        # the full-parameter engine has no block bank: the precomputed
+        # rung must resolve one rung down, not reach the constructor
+        assert (rpolicy.resolve_solver("precomputed",
+                                       supported=rpolicy.FULL_SOLVERS)
+                == "lissa")
+
+    def test_full_engine_ctor_rejects_precomputed(self):
+        model, train = _setup()
+        params = model.init_params(jax.random.PRNGKey(0))
+        with pytest.raises(ValueError, match="precomputed"):
+            FullInfluenceEngine(model, params, train, damping=DAMP,
+                                solver="precomputed")
+
+
+class TestFactorBankServing:
+    def test_hit_path_spearman_vs_direct(self, tmp_path):
+        model, train = _setup()
+        params = model.init_params(jax.random.PRNGKey(0))
+        _, bank, _ = _publish(tmp_path, model, params, train)
+        eng = _engine(model, params, train, tmp_path)
+        assert eng.ensure_factor_bank() == len(bank)
+
+        pts = np.asarray(bank.pairs[:16], np.int64)
+        res = eng.query_batch(pts)
+        st = eng.bank_stats()
+        assert st["hits"] == len(pts) and st["misses"] == 0
+
+        ref = _engine(model, params, train, solver="direct")
+        res_ref = ref.query_batch(pts)
+        assert np.array_equal(
+            res.related_idx[res.related_mask],
+            res_ref.related_idx[res_ref.related_mask],
+        )
+        for t in range(len(pts)):
+            a, b = res.scores_of(t), res_ref.scores_of(t)
+            if len(a) > 1 and (np.std(a) > 0 or np.std(b) > 0):
+                assert spearman(a, b) >= 0.999
+
+    def test_miss_falls_through_bitwise(self, tmp_path):
+        model, train = _setup()
+        params = model.init_params(jax.random.PRNGKey(0))
+        _, bank, _ = _publish(tmp_path, model, params, train)
+        eng = _engine(model, params, train, tmp_path)
+        eng.ensure_factor_bank()
+
+        miss = _miss_pairs(train, bank)
+        res = eng.query_batch(miss)
+        st = eng.bank_stats()
+        assert st["misses"] == len(miss) and st["hits"] == 0
+
+        # the miss rung is the ladder's next engine verbatim
+        ladder = _engine(model, params, train, solver="lissa")
+        res_ref = ladder.query_batch(miss)
+        for t in range(len(miss)):
+            assert np.array_equal(res.scores_of(t), res_ref.scores_of(t))
+        assert np.array_equal(res.ihvp, res_ref.ihvp)
+
+    def test_mixed_batch_partitions_and_merges(self, tmp_path):
+        model, train = _setup()
+        params = model.init_params(jax.random.PRNGKey(0))
+        _, bank, _ = _publish(tmp_path, model, params, train)
+        eng = _engine(model, params, train, tmp_path)
+        eng.ensure_factor_bank()
+
+        hit = np.asarray(bank.pairs[:3], np.int64)
+        miss = _miss_pairs(train, bank)
+        mixed = np.concatenate([miss[:1], hit[:2], miss[1:], hit[2:]])
+        res = eng.query_batch(mixed)
+        st = eng.bank_stats()
+        assert st["hits"] == 3 and st["misses"] == 3
+
+        # the merge is a permutation: each sub-batch served through its
+        # own path is bitwise what the merged stream holds at those
+        # positions (same-shape dispatches — a solo T=1 query would pad
+        # differently and only agree to the ulp)
+        hit_pos = [t for t, p in enumerate(mixed.tolist())
+                   if eng.bank_contains(*p)]
+        miss_pos = [t for t in range(len(mixed)) if t not in hit_pos]
+        assert len(hit_pos) == 3 and len(miss_pos) == 3
+
+        bank_eng = _engine(model, params, train, tmp_path)
+        bank_eng.ensure_factor_bank()
+        res_hit = bank_eng.query_batch(mixed[hit_pos])
+        assert bank_eng.bank_stats()["hits"] == len(hit_pos)
+        ladder = _engine(model, params, train, solver="lissa")
+        res_miss = ladder.query_batch(mixed[miss_pos])
+        for k, t in enumerate(hit_pos):
+            assert np.array_equal(res.scores_of(t), res_hit.scores_of(k))
+        for k, t in enumerate(miss_pos):
+            assert np.array_equal(res.scores_of(t), res_miss.scores_of(k))
+
+    def test_fallback_chain_precomputed_to_direct(self, tmp_path):
+        """Injected NaN payloads at every rung walk the full ladder
+        precomputed -> lissa -> cg -> direct, ending finite."""
+        model, train = _setup()
+        params = model.init_params(jax.random.PRNGKey(0))
+        _, bank, _ = _publish(tmp_path, model, params, train)
+        eng = _engine(model, params, train, tmp_path)
+        eng.ensure_factor_bank()
+        pts = np.asarray(bank.pairs[:4], np.int64)
+
+        walked = []
+        real_next = rpolicy.next_solver
+
+        def spy(current, *a, **kw):
+            nxt = real_next(current, *a, **kw)
+            walked.append((current, nxt))
+            return nxt
+
+        # one NaN corruption per rung above the bottom; pad_to pins a
+        # single pad group so each recompute is exactly one corrupt call
+        faults = [
+            inject.Fault(site=sites.ENGINE_SOLVE, at=k, kind="nan")
+            for k in range(3)
+        ]
+        with inject.active(*faults):
+            try:
+                rpolicy.next_solver = spy
+                res = eng.query_batch(pts, pad_to=128)
+            finally:
+                rpolicy.next_solver = real_next
+
+        assert eng.solver == "direct"
+        assert [w[0] for w in walked] == ["precomputed", "lissa", "cg"]
+        assert np.isfinite(res.ihvp).all()
+        ref = _engine(model, params, train, solver="direct")
+        res_ref = ref.query_batch(pts, pad_to=128)
+        for t in range(len(pts)):
+            assert np.array_equal(res.scores_of(t), res_ref.scores_of(t))
+
+    def test_torn_bank_quarantines_and_falls_through(self, tmp_path):
+        model, train = _setup()
+        params = model.init_params(jax.random.PRNGKey(0))
+        _, bank, path = _publish(tmp_path, model, params, train)
+        with open(path, "r+b") as fh:  # fialint: disable=FIA101 -- test corrupts an artifact in place, deliberately bypassing the integrity layer
+            fh.seek(max(os.path.getsize(path) // 2, 1))
+            fh.write(b"\xde\xad\xbe\xef")
+
+        eng = _engine(model, params, train, tmp_path)
+        assert eng.ensure_factor_bank() == 0
+        assert os.path.exists(path + ".corrupt")  # quarantined, kept
+
+        pts = np.asarray(bank.pairs[:3], np.int64)
+        res = eng.query_batch(pts)
+        ladder = _engine(model, params, train, solver="lissa")
+        res_ref = ladder.query_batch(pts)
+        for t in range(len(pts)):
+            assert np.array_equal(res.scores_of(t), res_ref.scores_of(t))
+
+
+class TestSurgicalInvalidation:
+    def _perturbed(self, model, params, u0):
+        """New params differing from ``params`` only in user u0's row."""
+        host = jax.tree_util.tree_map(np.asarray, params)
+        new = {k: np.array(v, copy=True) for k, v in host.items()}
+        new["P"][u0] += 0.125
+        return jax.tree_util.tree_map(np.asarray, new)
+
+    @staticmethod
+    def _stale_mask(bank, index, train, u0):
+        """Entries whose block Hessian reads P[u0]: the pair's own user,
+        or any pair whose item u0 rated (the d²/dQ[i]² term sums
+        P[u']P[u']^T over item i's raters)."""
+        return np.asarray([
+            int(u) == u0
+            or u0 in train.x[np.asarray(index.rows_of_item(int(i))), 0]
+            for u, i in bank.pairs.tolist()
+        ])
+
+    def test_refresh_drops_only_touched_entries(self, tmp_path):
+        model, train = _setup()
+        params = model.init_params(jax.random.PRNGKey(0))
+        builder, bank, path = _publish(tmp_path, model, params, train)
+        u0 = int(bank.pairs[0, 0])
+        stale = self._stale_mask(bank, builder.index, train, u0)
+        touched = int(stale.sum())
+        assert 0 < touched < len(bank)
+
+        new_params = self._perturbed(model, params, u0)
+        out = fbank.refresh_bank(
+            model, new_params, *builder._train_host, builder.index,
+            DAMP, path, NAME,
+        )
+        assert out == {"kept": len(bank) - touched, "dropped": touched}
+
+        # survivors reload verified under the new params and serve
+        # scores matching the exact solver at those params
+        eng = _engine(model, new_params, train, tmp_path)
+        assert eng.ensure_factor_bank() == out["kept"]
+        assert eng.bank_stats()["dropped_stale"] == 0
+        assert not eng.bank_contains(u0, int(bank.pairs[0, 1]))
+        kept = np.asarray(bank.pairs[~stale][:6], np.int64)
+        res = eng.query_batch(kept)
+        assert eng.bank_stats()["hits"] == len(kept)
+        ref = _engine(model, new_params, train, solver="direct")
+        res_ref = ref.query_batch(kept)
+        for t in range(len(kept)):
+            a, b = res.scores_of(t), res_ref.scores_of(t)
+            if len(a) > 1 and (np.std(a) > 0 or np.std(b) > 0):
+                assert spearman(a, b) >= 0.999
+
+    def test_stale_bank_never_served_without_refresh(self, tmp_path):
+        """A params update with NO refresh: the load itself must drop
+        the touched entries (dep_crc mismatch) — a stale factor is
+        structurally unservable, not just unpreferred."""
+        model, train = _setup()
+        params = model.init_params(jax.random.PRNGKey(0))
+        builder, bank, _ = _publish(tmp_path, model, params, train)
+        u0 = int(bank.pairs[0, 0])
+        touched = int(self._stale_mask(bank, builder.index, train,
+                                       u0).sum())
+        assert 0 < touched < len(bank)
+
+        new_params = self._perturbed(model, params, u0)
+        eng = _engine(model, new_params, train, tmp_path)
+        loaded = eng.ensure_factor_bank()
+        assert loaded == len(bank) - touched
+        assert eng.bank_stats()["dropped_stale"] == touched
+        assert not eng.bank_contains(u0, int(bank.pairs[0, 1]))
+
+        # a touched pair serves through the ladder, bitwise equal to a
+        # bank-less engine under the new params
+        pts = np.asarray([bank.pairs[0]], np.int64)
+        res = eng.query_batch(pts)
+        assert eng.bank_stats()["misses"] == 1
+        ladder = _engine(model, new_params, train, solver="lissa")
+        assert np.array_equal(res.scores_of(0),
+                              ladder.query_batch(pts).scores_of(0))
