@@ -1,7 +1,7 @@
 # Convenience targets. The commands themselves are pinned in
 # ROADMAP.md (tier-1) and scripts/ — these targets just name them.
 
-.PHONY: tier1 test lint lint-io serve-smoke multichip-smoke factor-smoke chaos-smoke chaos-soak
+.PHONY: tier1 test lint lint-io serve-smoke multichip-smoke factor-smoke chaos-smoke chaos-soak churn-smoke
 
 # The ROADMAP.md tier-1 verify: fast CPU suite, slow tests excluded.
 # Lint is fatal — a finding fails the build before pytest runs.
@@ -50,6 +50,12 @@ factor-smoke:
 # the schedule format and oracle catalog.
 chaos-smoke:
 	bash scripts/chaos_smoke.sh
+
+# Churn smoke: serving under two mid-stream online model updates on
+# CPU (<60s) — zero stale hits, surgical (<=5%) recompute footprint,
+# bounded epoch-fence staleness window (docs/design.md §17).
+churn-smoke:
+	bash scripts/churn_smoke.sh
 
 # Chaos soak: a seed-range sweep over the FULL fault domain (kill
 # kinds, NaN payloads, deadlines) — the fuzz mode; not part of tier-1.

@@ -1001,6 +1001,188 @@ def serve_main():
     _maybe_json_out(out)
 
 
+def serve_churn_main():
+    """``python bench.py serve --churn [--quick]`` — serving under
+    online model updates (docs/design.md §17).
+
+    The train set is community-structured (interactions never cross
+    group boundaries), so an update confined to group 0 provably
+    touches only that group's blocks — ≤5% of the hot set. Three
+    phases replay the same request-wave stream:
+
+    - **baseline**: no updates (steady p50/p99 with a controlled miss
+      rate — one cold pair per wave keeps the tail honest);
+    - **churn**: two mid-stream ``FIAModel.apply_updates`` with
+      surgical epoch-fenced swaps (untouched hot/disk entries re-key,
+      only the touched footprint recomputes);
+    - **wholesale**: the same two updates followed by a full cache
+      flush — the baseline surgical invalidation replaces.
+
+    Every post-update hot-set response is verified byte-for-byte
+    against a fresh compute on the live engine (``stale_hits`` must be
+    0), and the surgical accounting lands in the metrics JSONL
+    (``stream.swap`` events). Prints ONE JSON line.
+    """
+    _ensure_live_backend()
+    import shutil
+    import tempfile
+
+    import jax
+
+    from fia_tpu.api import FIAModel
+    from fia_tpu.data.dataset import RatingDataset
+    from fia_tpu.serve import InfluenceService, Request, ServeConfig
+
+    if QUICK:
+        groups, gu, gi, rows_per, steps, waves = 25, 10, 6, 50, 300, 6
+    else:
+        groups, gu, gi, rows_per, steps, waves = 40, 12, 8, 80, 1_500, 10
+    users, items = groups * gu, groups * gi
+    k, wd, damping, batch = 16, 1e-3, 1e-6, 1000
+    upd_steps = 40
+
+    rng = np.random.default_rng(0)
+    xs = []
+    for g in range(groups):
+        xs.append(np.stack([
+            rng.integers(g * gu, (g + 1) * gu, rows_per),
+            rng.integers(g * gi, (g + 1) * gi, rows_per),
+        ], axis=1))
+    x = np.concatenate(xs).astype(np.int32)
+    y = rng.integers(1, 6, len(x)).astype(np.float32)
+
+    workdir = tempfile.mkdtemp(prefix="fia-churn-bench-")
+    metrics_path = os.path.join(workdir, "serve_metrics.jsonl")
+    _stage(f"churn bench: training {steps} steps on {len(x)} rows "
+           f"({groups} communities)")
+    fm = FIAModel(
+        "MF", users, items, k, wd, batch_size=batch,
+        data_sets={"train": RatingDataset(x, y)},
+        initial_learning_rate=1e-2, damping=damping,
+        train_dir=workdir, model_name="bench-stream", solver="direct",
+        seed=0,
+    )
+    fm.train(steps, save_checkpoints=False, verbose=False)
+
+    # one hot block per community + a cold-pair generator (unseen pairs
+    # inside each group, so every wave pays exactly one honest compute)
+    hot = [(g * gu, g * gi) for g in range(groups)]
+    cold_iter = iter([(g * gu + 1, g * gi + 1) for g in range(groups)]
+                     * 4)
+
+    def upd_rows(seed):
+        r = np.random.default_rng(seed)
+        ux = np.stack([r.integers(0, gu, 5), r.integers(0, gi, 5)],
+                      axis=1).astype(np.int32)
+        return ux, r.integers(1, 6, 5).astype(np.float32)
+
+    def one(svc, pair):
+        t0 = time.perf_counter()
+        r = svc.run([Request(*pair)], drain_every=1)[0]
+        return r, (time.perf_counter() - t0) * 1e3
+
+    def fresh_bytes(pair):
+        """Reference bytes from a fresh compute on the live engine."""
+        probe = InfluenceService.from_model(
+            fm, config=ServeConfig(disk_cache=False))
+        return np.asarray(probe.run([Request(*pair)])[0].scores).tobytes()
+
+    def phase(svc, update_at=(), wholesale=False, seed0=100):
+        lat, swap_lat, recomputes, stale = [], [], 0, 0
+        results = []
+        post_update = False
+        for w in range(waves):
+            if w in update_at:
+                ux, uy = upd_rows(seed0 + w)
+                res = fm.apply_updates(ux, uy, steps=upd_steps,
+                                       checkpoint_every=upd_steps // 2)
+                assert res.committed, res.reason
+                results.append(res)
+                if wholesale:
+                    # emulate a fingerprint-only system: nothing
+                    # survives the update — hot LRU flushed AND the
+                    # disk generation (surgically re-keyed above by
+                    # apply_updates) dropped
+                    svc.invalidate()
+                    shutil.rmtree(os.path.join(workdir, "serve"),
+                                  ignore_errors=True)
+                post_update = True
+            for pair in hot + [next(cold_iter)]:
+                r, ms = one(svc, pair)
+                lat.append(ms)
+                if post_update:
+                    swap_lat.append(ms)
+                    if pair in hot:
+                        if r.cache_tier == "compute":
+                            recomputes += 1
+                        stale += (np.asarray(r.scores).tobytes()
+                                  != fresh_bytes(pair))
+            post_update = False
+        a = np.asarray(lat)
+        out = {
+            "p50_ms": round(float(np.percentile(a, 50)), 3),
+            "p99_ms": round(float(np.percentile(a, 99)), 3),
+            "hot_recomputes_after_update": recomputes,
+            "stale_hits": stale,
+        }
+        if swap_lat:
+            s = np.asarray(swap_lat)
+            out["swap_window_p99_ms"] = round(float(np.percentile(s, 99)), 3)
+        if results:
+            out["updates"] = [{
+                "update_id": r.update_id,
+                "staleness_ms": round(r.staleness_s * 1e3, 3),
+                "touched_users": r.touched_users,
+                "touched_items": r.touched_items,
+                "seconds": round(r.seconds, 3),
+            } for r in results]
+        return out
+
+    svc = InfluenceService.from_model(
+        fm, config=ServeConfig(max_batch=32,
+                               metrics_path=metrics_path))
+    for pair in hot:  # warm the hot tier
+        one(svc, pair)
+
+    mid = (waves // 3, 2 * waves // 3)
+    _stage("churn bench: baseline phase (no updates)")
+    baseline = phase(svc)
+    _stage("churn bench: churn phase (2 surgical updates mid-stream)")
+    churn = phase(svc, update_at=mid, seed0=200)
+    st = svc.cache.stats
+    surgical = {
+        "hot_rekeyed": int(st.rekeyed),
+        "hot_dropped": int(st.rekey_dropped),
+        "disk_rekeyed": int(st.disk_rekeyed),
+        "disk_dropped": int(st.disk_rekey_dropped),
+    }
+    _stage("churn bench: wholesale-invalidation baseline phase")
+    wholesale = phase(svc, update_at=mid, wholesale=True, seed0=300)
+
+    touched_frac = 1.0 / groups  # updates stay inside community 0
+    out = {
+        "metric": "fia-serve churn p99 ratio (surgical vs no-churn)",
+        "value": round(churn["p99_ms"] / max(baseline["p99_ms"], 1e-9), 3),
+        "unit": "x",
+        "details": {
+            "backend": jax.default_backend(),
+            "hot_blocks": len(hot),
+            "touched_block_fraction": touched_frac,
+            "baseline": baseline,
+            "churn": churn,
+            "wholesale": wholesale,
+            "surgical_accounting": surgical,
+            "metrics_jsonl": metrics_path,
+        },
+    }
+    assert churn["stale_hits"] == 0, "served stale bytes under churn"
+    assert churn["hot_recomputes_after_update"] < \
+        wholesale["hot_recomputes_after_update"], \
+        "surgical invalidation recomputed as much as a wholesale flush"
+    print(json.dumps(out))
+    _maybe_json_out(out)
+
+
 def multichip_main():
     """``python bench.py multichip [--quick] [--json_out PATH]`` — the
     standalone device-sweep artifact (MULTICHIP_r0*.json).
@@ -1097,7 +1279,10 @@ if __name__ == "__main__":
     if "--lint" in sys.argv[1:]:
         _lint_preflight()
     if "serve" in sys.argv[1:]:
-        serve_main()
+        if "--churn" in sys.argv[1:]:
+            serve_churn_main()
+        else:
+            serve_main()
     elif "multichip" in sys.argv[1:]:
         multichip_main()
     else:

@@ -88,6 +88,13 @@ class FIAModel:
         # a dropped service doesn't pin its caches, but a live one is
         # told when the state it cached against is gone
         self._serving = weakref.WeakSet()
+        # memoized derived state, keyed by object identity of its inputs
+        # (datasets and params trees are replaced, never mutated): the
+        # interaction index over the current train set and the host-side
+        # params snapshot — rebuilt only when the underlying arrays
+        # actually change, not on every invalidation
+        self._index_memo: tuple | None = None  # (x, y, InteractionIndex)
+        self._host_params_memo: tuple | None = None  # (params, host tree)
 
     # -- properties --------------------------------------------------------
     @property
@@ -131,6 +138,58 @@ class FIAModel:
         for svc in list(self._serving):
             svc.invalidate()
 
+    def _interaction_index(self):
+        """The interaction index over the current train set, memoized on
+        the train arrays' identity (datasets are replaced, not mutated —
+        holding the arrays in the memo key keeps the identity stable)."""
+        train = self.data_sets["train"]
+        memo = self._index_memo
+        if memo is None or memo[0] is not train.x or memo[1] is not train.y:
+            from fia_tpu.data.index import InteractionIndex
+
+            self._index_memo = memo = (
+                train.x, train.y,
+                InteractionIndex(np.asarray(train.x),
+                                 self.model.num_users,
+                                 self.model.num_items),
+            )
+        return memo[2]
+
+    def _host_params(self):
+        """Host-side snapshot of the current params, memoized on the
+        params tree's identity — one device→host transfer per state, not
+        one per invalidation pass."""
+        params = self.state.params
+        memo = self._host_params_memo
+        if memo is None or memo[0] is not params:
+            self._host_params_memo = memo = (
+                params, jax.tree_util.tree_map(np.asarray, params)
+            )
+        return memo[1]
+
+    def _log_event(self, event: str, **fields) -> None:
+        """Route a model-lifecycle event into the serving metrics JSONL.
+
+        Mirrored to every registered service's metrics log (machine-
+        readable alongside ``serve.request`` records; the event names
+        are declared in ``serve/metrics.py`` SCHEMA). With no serving
+        layer attached, falls back to one human-readable stderr-style
+        line so the old print diagnostics are never silently lost.
+        """
+        recorder = {
+            "stream.update": "record_update",
+            "factor.refresh": "record_factor_refresh",
+        }.get(event)
+        sent = False
+        for svc in list(self._serving):
+            fn = getattr(svc.metrics, recorder, None) if recorder else None
+            if fn is not None:
+                fn(**fields)
+                sent = True
+        if not sent:
+            body = " ".join(f"{k}={v}" for k, v in fields.items())
+            print(f"[{event}] {body}")
+
     def _refresh_factor_bank(self):
         """Surgical factor-bank invalidation on a params/train change
         (see :func:`fia_tpu.influence.factor.refresh_bank`). A missing
@@ -144,22 +203,16 @@ class FIAModel:
         path = fbank.default_bank_path(self.train_dir, self.model_name)
         if not os.path.exists(path):
             return
-        from fia_tpu.data.index import InteractionIndex
-
         train = self.data_sets["train"]
-        params_host = jax.tree_util.tree_map(np.asarray, self.state.params)
-        index = InteractionIndex(
-            np.asarray(train.x), self.model.num_users, self.model.num_items
-        )
         stats = fbank.refresh_bank(
-            self.model, params_host, np.asarray(train.x),
-            np.asarray(train.y), index, self.damping, path,
-            self.model_name,
+            self.model, self._host_params(), np.asarray(train.x),
+            np.asarray(train.y), self._interaction_index(), self.damping,
+            path, self.model_name,
         )
-        if stats["dropped"]:
-            print(
-                f"[factor-bank] params change: kept {stats['kept']} "
-                f"entries, dropped {stats['dropped']} stale"
+        if stats["kept"] or stats["dropped"]:
+            self._log_event(
+                "factor.refresh", kept=stats["kept"],
+                dropped=stats["dropped"], model_key=self.model_name,
             )
 
     def _register_serving(self, svc) -> None:
@@ -358,6 +411,28 @@ class FIAModel:
             emb0 = model.extract_block(params, uj, ij)
             out.append(jax.grad(influence_of_embeddings)(emb0))
         return out
+
+    # -- streaming updates (docs/design.md §17) -----------------------------
+    def apply_updates(self, new_interactions, new_y=None, steps: int = 100,
+                      checkpoint_every: int | None = None):
+        """Online model update: append interactions, fine-tune, swap.
+
+        ``new_interactions``: (N, 2) int ids with ``new_y`` (N,) ratings,
+        an (N, 3) combined [user, item, rating] array, or a
+        :class:`~fia_tpu.data.dataset.RatingDataset`. Fine-tunes
+        ``steps`` minibatch steps on the grown train set (crash-safe:
+        a killed update resumes bit-identically from its rotated
+        checkpoints on the next identical call), then performs the
+        epoch-fenced swap — registered services keep answering in-flight
+        requests on the old params epoch, and only the touched (user,
+        item) blocks are invalidated across the serve/factor-bank tiers.
+        A classified failure rolls back to the old state and keeps
+        serving. Returns a :class:`fia_tpu.stream.update.UpdateResult`.
+        """
+        from fia_tpu.stream.update import apply_updates as _apply
+
+        return _apply(self, new_interactions, new_y=new_y, steps=steps,
+                      checkpoint_every=checkpoint_every)
 
     # -- dataset mutation (genericNeuralNet.py:870-891) ---------------------
     def update_train_x(self, new_x):

@@ -721,6 +721,243 @@ class FactorBankScenario(Scenario):
         return failures
 
 
+class UpdateWhileServingScenario(Scenario):
+    """Streaming updates (``FIAModel.apply_updates``) under live serving,
+    mid-update kills, and swap faults — docs/design.md §17.
+
+    The train set is split into two non-interacting communities; both
+    updates land entirely in community A, so community-B probes are
+    provably outside every footprint. Three fault-free reference states
+    (base, after update 1, after both) are served T=1 at construction;
+    every probe answered during a chaos run must match the reference of
+    the state it was admitted under, byte-for-byte:
+
+    - pre/mid/post waves pin serving to base / post-1 / post-2 state;
+    - a ticket submitted BEFORE update 2 and drained after it must
+      answer on its admission epoch (the fenced post-1 state);
+    - untouched (community-B) probes must be bit-identical in every
+      wave — the local-update projection at work;
+    - a rolled-back attempt must leave serving answering the old state,
+      and the retry (resuming the attempt's checkpoints) must commit to
+      the same bytes as the uninterrupted golden run;
+    - a committed swap must re-key untouched cache entries, never
+      wholesale-flush (``swap_stats`` oracle).
+    """
+
+    name = "update_while_serving"
+    BASE_STEPS, STEPS, EVERY = 24, 16, 4
+    # community A: users 0-14 x items 0-9; community B: the rest. The
+    # update rows below stay inside A, so B probes are untouched by
+    # construction (footprint reach cannot cross communities).
+    TOUCHED = ((2, 3), (5, 1), (11, 8))
+    UNTOUCHED = ((16, 12), (22, 17), (28, 11))
+    FENCE = (2, 3)
+    # each update fires stream.update once and stream.swap once on a
+    # fault-free attempt: 2 guaranteed calls per site across the two
+    # updates; the retry budget (4 attempts/update) absorbs a worst-case
+    # 3-fault smoke schedule on one site with one attempt to spare
+    benign_domain = {
+        sites.STREAM_UPDATE: (_TRANSIENT_KINDS, 2),
+        sites.STREAM_SWAP: (_TRANSIENT_KINDS, 2),
+    }
+    full_domain = {
+        sites.STREAM_UPDATE: (_TRANSIENT_KINDS + _KILL_KINDS, 2),
+        sites.STREAM_SWAP: (_TRANSIENT_KINDS + _KILL_KINDS, 2),
+        sites.CHAOS_SCENARIO: ((taxonomy.WORKER,), 1),
+    }
+
+    @staticmethod
+    def _community_data(seed: int, n: int):
+        rng = np.random.default_rng(seed)
+        half = n // 2
+        xa = np.stack([rng.integers(0, 15, half),
+                       rng.integers(0, 10, half)], axis=1)
+        xb = np.stack([rng.integers(15, _U, n - half),
+                       rng.integers(10, _I, n - half)], axis=1)
+        x = np.concatenate([xa, xb]).astype(np.int32)
+        y = rng.integers(1, 6, n).astype(np.float32)
+        return x, y
+
+    def __init__(self):
+        import tempfile
+
+        from fia_tpu.api import FIAModel
+        from fia_tpu.data.dataset import RatingDataset
+
+        x, y = self._community_data(0, 240)
+        self.fm = FIAModel(
+            "MF", _U, _I, _K, _WD, batch_size=50,
+            data_sets={"train": RatingDataset(x, y)},
+            initial_learning_rate=1e-2, damping=_DAMP,
+            train_dir=tempfile.mkdtemp(prefix="fia-chaos-stream-init-"),
+            model_name="chaos-stream", solver="direct", seed=0,
+        )
+        # virtual time everywhere: retry backoff and staleness timers
+        # must never sleep wall-clock in a chaos run
+        self.fm._trainer.clock = rpolicy.VirtualClock()
+        self.fm.train(self.BASE_STEPS, save_checkpoints=False,
+                      verbose=False)
+        self.base_state = self.fm.state
+        self.base_train = self.fm.data_sets["train"]
+        # both update batches live strictly inside community A; update 2
+        # touches user 2 so the FENCE probe distinguishes mid from post
+        self.upd1 = (np.array([[2, 3], [5, 1], [11, 8]], np.int32),
+                     np.array([5.0, 4.0, 3.0], np.float32))
+        self.upd2 = (np.array([[2, 5], [7, 2], [13, 6]], np.int32),
+                     np.array([2.0, 5.0, 4.0], np.float32))
+
+        # fault-free per-state references, each probe served alone (T=1)
+        # so bytes are independent of batch composition
+        self.ref_old = self._snapshot_refs()
+        assert self.fm.apply_updates(*self.upd1, steps=self.STEPS,
+                                     checkpoint_every=self.EVERY).committed
+        self.ref_mid = self._snapshot_refs()
+        assert self.fm.apply_updates(*self.upd2, steps=self.STEPS,
+                                     checkpoint_every=self.EVERY).committed
+        self.ref_new = self._snapshot_refs()
+        self._reset()
+        for p in self.UNTOUCHED:
+            # the projection guarantee surgical invalidation rests on
+            assert self.ref_old[p] == self.ref_mid[p] == self.ref_new[p], (
+                f"untouched probe {p} moved across a footprinted update")
+        assert self.ref_old[self.FENCE] != self.ref_mid[self.FENCE]
+        assert self.ref_mid[self.FENCE] != self.ref_new[self.FENCE]
+
+    def _reset(self):
+        self.fm.state = self.base_state
+        self.fm.data_sets["train"] = self.base_train
+        self.fm._engines.clear()
+
+    def _service(self):
+        from fia_tpu.serve.service import InfluenceService, ServeConfig
+
+        return InfluenceService.from_model(
+            self.fm, config=ServeConfig(), clock=rpolicy.VirtualClock())
+
+    def _one(self, svc, pair, rid):
+        from fia_tpu.serve.request import Request
+
+        return svc.run([Request(pair[0], pair[1], id=rid)],
+                       drain_every=1)[0]
+
+    def _snapshot_refs(self) -> dict:
+        svc = self._service()
+        return {
+            p: np.asarray(self._one(svc, p, f"ref{k}").scores).tobytes()
+            for k, p in enumerate(self.TOUCHED + self.UNTOUCHED)
+        }
+
+    def _wave(self, svc, wave: str, refs: dict, out: dict,
+              events: list) -> None:
+        for k, p in enumerate(self.TOUCHED + self.UNTOUCHED):
+            r = self._one(svc, p, f"{wave}{k}")
+            match = bool(r.ok) and (
+                np.asarray(r.scores).tobytes() == refs[p])
+            events.append({"event": "probe_served", "wave": wave,
+                           "probe": k, "match": match})
+            if r.ok:
+                out[f"{wave}{k}:scores"] = np.asarray(r.scores).copy()
+
+    def _apply(self, svc, upd, events: list, tag: int,
+               probe_on_rollback: bool):
+        """One update under the chaos retry budget; a rolled-back
+        attempt leaves its checkpoints behind, so the retry resumes."""
+        for attempt in range(_CHAOS_RETRY.max_attempts):
+            r = self.fm.apply_updates(*upd, steps=self.STEPS,
+                                      checkpoint_every=self.EVERY)
+            if r.committed:
+                if attempt:
+                    events.append({"event": "update_retried",
+                                   "update": tag,
+                                   "attempts": attempt + 1})
+                return r
+            events.append({"event": "update_rolled_back", "update": tag,
+                           "reason": r.reason,
+                           "resumed_step": r.resumed_step})
+            if probe_on_rollback:
+                # rollback must keep answering — on the OLD state
+                pr = self._one(svc, self.FENCE, f"rb{tag}-{attempt}")
+                events.append({
+                    "event": "post_rollback_serve", "update": tag,
+                    "ok": bool(pr.ok) and (
+                        np.asarray(pr.scores).tobytes()
+                        == self.ref_old[self.FENCE]),
+                })
+        raise taxonomy.DeadlineExpired(
+            f"update {tag} never committed within the retry budget")
+
+    def run(self, workdir: str, events: list) -> dict:
+        from fia_tpu.serve.request import Request
+
+        self._reset()
+        self.fm.train_dir = os.path.join(workdir, "train")
+        svc = self._service()
+        out: dict = {}
+
+        self._wave(svc, "pre", self.ref_old, out, events)
+        r1 = self._apply(svc, self.upd1, events, 1,
+                         probe_on_rollback=True)
+        self._wave(svc, "mid", self.ref_mid, out, events)
+
+        # epoch fence: admitted before update 2, drained after — must
+        # answer on its admission state whatever the update does
+        assert svc.submit(Request(*self.FENCE, id="fence")) is None
+        r2 = self._apply(svc, self.upd2, events, 2,
+                         probe_on_rollback=False)
+        fr = next(r for r in svc.drain() if r.id == "fence")
+        events.append({"event": "probe_served", "wave": "fence",
+                       "probe": 0,
+                       "match": bool(fr.ok) and (
+                           np.asarray(fr.scores).tobytes()
+                           == self.ref_mid[self.FENCE])})
+        if fr.ok:
+            out["fence:scores"] = np.asarray(fr.scores).copy()
+        self._wave(svc, "post", self.ref_new, out, events)
+
+        st = svc.cache.stats
+        events.append({"event": "swap_stats",
+                       "rekeyed": int(st.rekeyed),
+                       "rekey_dropped": int(st.rekey_dropped),
+                       "disk_rekeyed": int(st.disk_rekeyed),
+                       "disk_rekey_dropped": int(st.disk_rekey_dropped)})
+        out["update1"] = r1.status
+        out["update2"] = r2.status
+        out["epochs"] = int(svc.epoch)
+        return out
+
+    def check(self, golden: dict, record) -> list:
+        from fia_tpu.chaos.oracles import OracleFailure
+
+        if record.error is not None or record.outcome is None:
+            return []
+        failures = []
+        for e in record.events:
+            if e.get("event") == "probe_served" and not e["match"]:
+                failures.append(OracleFailure(
+                    "epoch_serving_integrity",
+                    f"wave {e['wave']} probe {e['probe']}: served bytes "
+                    "do not match the reference of the state the request "
+                    "was admitted under (stale or half-swapped answer)",
+                ))
+            elif e.get("event") == "post_rollback_serve" and not e["ok"]:
+                failures.append(OracleFailure(
+                    "rollback_keeps_serving",
+                    f"after a rolled-back update {e['update']}, serving "
+                    "did not answer bit-identically on the old state",
+                ))
+        stats = next((e for e in record.events
+                      if e.get("event") == "swap_stats"), None)
+        if stats is not None and (
+                stats["rekeyed"] + stats["disk_rekeyed"]) == 0:
+            failures.append(OracleFailure(
+                "surgical_invalidation",
+                "no cache entry survived the swaps by re-keying — the "
+                "untouched community-B blocks must ride through a "
+                "footprinted update without recompute",
+            ))
+        return failures
+
+
 def make_scenarios() -> dict:
     """Fresh scenario registry (instances are lazily constructed so the
     selftest path never imports jax)."""
@@ -732,6 +969,7 @@ def make_scenarios() -> dict:
         ServeStreamScenario.name: ServeStreamScenario,
         ServeStreamMeshScenario.name: ServeStreamMeshScenario,
         FactorBankScenario.name: FactorBankScenario,
+        UpdateWhileServingScenario.name: UpdateWhileServingScenario,
     }
 
 

@@ -211,6 +211,29 @@ def verify(
     return m
 
 
+def rewrite_fingerprint(path: str, fingerprint) -> bool:
+    """Re-key an intact artifact to a new config fingerprint in place.
+
+    The manifest's checksum covers only the data file's bytes, so an
+    entry whose *content* is provably unchanged across a config change
+    (e.g. a serve-tier block untouched by a streaming params update) can
+    adopt the new fingerprint by republishing just the manifest — no
+    recompute, no data rewrite. The data bytes are verified against the
+    existing manifest first: a torn or rotted entry is never laundered
+    into the new generation (it stays behind under the old fingerprint
+    and dies as a verified miss). Returns True when re-keyed, False when
+    the entry is missing or fails verification.
+    """
+    try:
+        m = verify(path, require_manifest=True)
+    except ArtifactIntegrityError:
+        return False
+    m = dict(m)
+    m["fingerprint"] = canonical_fingerprint(fingerprint)
+    _write_atomic_json(manifest_path(path), m)
+    return True
+
+
 def quarantine(path: str, reason: str = "") -> list[str]:
     """Move a failed artifact (and its manifest) aside as evidence.
 

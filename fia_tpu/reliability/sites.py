@@ -56,6 +56,10 @@ ARTIFACTS_PUBLISH = "artifacts.publish"
 SERVE_DISPATCH = "serve.dispatch"
 SERVE_CACHE_PUBLISH = "serve.cache_publish"
 
+# -- streaming updates -------------------------------------------------
+STREAM_UPDATE = "stream.update"
+STREAM_SWAP = "stream.swap"
+
 # -- chaos scenario engine ---------------------------------------------
 CHAOS_SCENARIO = "chaos.scenario"
 CHAOS_UNIT = "chaos.unit"
@@ -76,6 +80,8 @@ ALL_SITES = frozenset({
     ARTIFACTS_PUBLISH,
     SERVE_DISPATCH,
     SERVE_CACHE_PUBLISH,
+    STREAM_UPDATE,
+    STREAM_SWAP,
     CHAOS_SCENARIO,
     CHAOS_UNIT,
 })

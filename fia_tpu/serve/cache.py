@@ -48,6 +48,13 @@ class CacheStats:
     evictions: int = 0
     invalidations: int = 0
     disk_rejects: int = 0  # corrupt/foreign disk entries refused
+    # surgical-invalidation accounting (streaming updates): entries
+    # re-keyed to a new params fingerprint without recompute vs dropped
+    # because the update's footprint touched them
+    rekeyed: int = 0
+    rekey_dropped: int = 0
+    disk_rekeyed: int = 0
+    disk_rekey_dropped: int = 0
 
     def json(self) -> dict:
         return dict(self.__dict__)
@@ -134,6 +141,34 @@ class HotBlockCache:
         self._entries.clear()
         self._nbytes = 0
 
+    def rekey(self, old_fp: str, new_fp: str, touched) -> dict:
+        """Surgical re-key after a footprinted params update.
+
+        Entries under ``old_fp`` whose (user, item) block the update's
+        footprint did NOT touch adopt ``new_fp`` in place — the update
+        provably left their solved block bit-identical, so the cached
+        payload is still the answer the new engine would compute.
+        Touched entries (and entries under any other fingerprint) are
+        dropped. LRU order is preserved. ``touched`` is a
+        ``(user, item) -> bool`` predicate
+        (:meth:`fia_tpu.stream.footprint.Footprint.touched`).
+        """
+        out: OrderedDict[tuple, BlockEntry] = OrderedDict()
+        nbytes = 0
+        rekeyed = dropped = 0
+        for key, e in self._entries.items():
+            if key[0] == old_fp and not touched(key[2], key[3]):
+                out[(new_fp,) + key[1:]] = e
+                nbytes += e.nbytes
+                rekeyed += 1
+            else:
+                dropped += 1
+        self._entries = out
+        self._nbytes = nbytes
+        self.stats.rekeyed += rekeyed
+        self.stats.rekey_dropped += dropped
+        return {"rekeyed": rekeyed, "dropped": dropped}
+
 
 # -- on-disk tier ----------------------------------------------------------
 
@@ -188,6 +223,62 @@ def disk_get(path: str, fingerprint: dict,
         if stats is not None:
             stats.disk_rejects += 1
         return None
+
+
+def disk_rekey(cache_dir: str, model_name: str, solver: str,
+               old_fp: str, new_fp: str, touched,
+               stats: CacheStats | None = None) -> dict:
+    """Surgical re-key of the on-disk serve tier (streaming updates).
+
+    Walks ``<cache_dir>/serve/`` entries of this (model, solver):
+    touched blocks are unlinked (their payload is stale under the new
+    params); untouched blocks — whose manifest fingerprint matches the
+    OLD params digest and whose bytes verify — adopt the new fingerprint
+    via a manifest-only rewrite
+    (:func:`fia_tpu.reliability.artifacts.rewrite_fingerprint`): no
+    recompute, no data rewrite, and a torn/foreign entry is skipped, so
+    nothing stale is ever laundered into the new generation.
+    """
+    import re
+
+    from fia_tpu.reliability import artifacts
+
+    d = os.path.join(cache_dir, "serve")
+    out = {"rekeyed": 0, "dropped": 0}
+    if not os.path.isdir(d):
+        return out
+    pat = re.compile(
+        re.escape(f"{model_name}-{solver}-") + r"u(\d+)-i(\d+)\.npz"
+    )
+    old_want = artifacts.canonical_fingerprint(
+        disk_fingerprint(model_name, solver, old_fp)
+    )
+    new_fingerprint = disk_fingerprint(model_name, solver, new_fp)
+    for fn in sorted(os.listdir(d)):
+        m = pat.fullmatch(fn)
+        if m is None:
+            continue
+        path = os.path.join(d, fn)
+        if touched(int(m.group(1)), int(m.group(2))):
+            for p in (path, artifacts.manifest_path(path)):
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+            out["dropped"] += 1
+            continue
+        try:
+            man = artifacts.read_manifest(path)
+        except artifacts.ArtifactIntegrityError:
+            continue  # damaged manifest: leave for the read path's miss
+        if man is None or man.get("fingerprint") != old_want:
+            continue  # foreign/older generation: unservable either way
+        if artifacts.rewrite_fingerprint(path, new_fingerprint):
+            out["rekeyed"] += 1
+    if stats is not None:
+        stats.disk_rekeyed += out["rekeyed"]
+        stats.disk_rekey_dropped += out["dropped"]
+    return out
 
 
 def disk_put(path: str, entry: BlockEntry, fingerprint: dict) -> None:

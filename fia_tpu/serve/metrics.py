@@ -56,6 +56,20 @@ SCHEMA = {
         "queue_wait_ms", "solve_ms", "batches", "mean_batch_size",
         "cache",
     ),
+    # streaming updates (docs/design.md §17): one line per
+    # apply_updates attempt, and one per epoch-fenced serving swap with
+    # its surgical-invalidation accounting
+    "stream.update": (
+        "update_id", "status", "reason", "steps", "new_rows",
+        "base_step", "resumed_step", "touched_users", "touched_items",
+        "staleness_ms", "seconds",
+    ),
+    "stream.swap": (
+        "epoch", "wholesale", "hot_rekeyed", "hot_dropped",
+        "disk_rekeyed", "disk_dropped",
+    ),
+    # surgical factor-bank refresh on a params/train change
+    "factor.refresh": ("kept", "dropped", "model_key"),
 }
 
 
@@ -108,6 +122,18 @@ class ServeMetrics:
             total_rows=int(total_rows),
             solve_ms=round(solve_s * 1e3, 3), status=status,
         )
+
+    def record_update(self, **fields) -> None:
+        """One ``stream.update`` line (an apply_updates attempt)."""
+        self.log.log("stream.update", **fields)
+
+    def record_swap(self, **fields) -> None:
+        """One ``stream.swap`` line (an epoch-fenced serving swap)."""
+        self.log.log("stream.swap", **fields)
+
+    def record_factor_refresh(self, **fields) -> None:
+        """One ``factor.refresh`` line (surgical bank revalidation)."""
+        self.log.log("factor.refresh", **fields)
 
     def rollup(self, cache_stats: dict | None = None) -> dict:
         n = sum(self.by_status.values())

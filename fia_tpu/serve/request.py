@@ -52,6 +52,10 @@ class Ticket:
     req: Request
     t_arrival: float
     t_deadline: float | None  # absolute, on the service clock
+    # serving epoch this ticket was admitted under: a drain resolves it
+    # against that epoch's fenced (engine, fingerprint) even if a
+    # streaming update swapped the model in between (docs/design.md §17)
+    epoch: int = 0
 
     def expired(self, now: float) -> bool:
         return self.t_deadline is not None and now > self.t_deadline

@@ -164,6 +164,13 @@ class InfluenceService:
         self._next_id = 0
         self._batch_id = 0
         self._fp_cache: tuple | None = None  # (engine identity, digest)
+        # Epoch fence (docs/design.md §17): tickets are stamped with the
+        # serving epoch at admission; a streaming update pins the old
+        # (engine, fp) here before swapping, so a drain resolves each
+        # ticket against the state it was admitted under. Entries are
+        # cleared once the queue that referenced them is consumed.
+        self._epoch = 0
+        self._fenced: dict[int, tuple] = {}  # epoch -> (engine, fp)
         # dispatch log: (batch_id, (T, 2) points) per device dispatch —
         # the byte-identity tests and capacity post-mortems read this
         self.dispatch_log: list[tuple[int, np.ndarray]] = []
@@ -213,10 +220,69 @@ class InfluenceService:
         Called by ``FIAModel._invalidate()`` (retrain, checkpoint load,
         train-set mutation). The fingerprinted keys already make stale
         hits impossible; this additionally frees the dead entries and
-        forgets the memoized engine fingerprint.
+        forgets the memoized engine fingerprint. Fenced epochs are
+        dropped too — wholesale invalidation means queued tickets
+        resolve against the fresh state, exactly as before streaming
+        updates existed.
         """
         self.cache.invalidate()
         self._fp_cache = None
+        self._fenced.clear()
+
+    # -- epoch-fenced streaming swap (docs/design.md §17) ------------------
+    @property
+    def epoch(self) -> int:
+        return self._epoch
+
+    def pin_epoch(self) -> None:
+        """Fence the current (engine, fingerprint) under the serving
+        epoch — called by the streaming update loop *before* the model
+        mutates, so tickets admitted under this epoch keep resolving
+        against exactly this state. Harmless if the update later rolls
+        back (the fence is cleared at the next drain)."""
+        self._fenced[self._epoch] = self._engine_and_fp()
+
+    def advance_epoch(self, footprint=None) -> dict:
+        """Swap serving onto the model's new state, surgically.
+
+        Bumps the serving epoch (new admissions stamp the new one),
+        resolves the NEW engine and fingerprint — making the new state
+        resident *before* any old entry is dropped — then, given a
+        ``footprint`` (:class:`fia_tpu.stream.footprint.Footprint` or a
+        ``(user, item) -> bool`` predicate), re-keys every untouched
+        hot/disk entry to the new fingerprint in place and drops exactly
+        the touched blocks. Without a footprint the hot tier is
+        wholesale-invalidated (the epoch fence still holds for queued
+        tickets). Returns the swap accounting, also logged as a
+        ``stream.swap`` metrics event.
+        """
+        old = self._fenced.get(self._epoch) or self._fp_cache
+        if old is not None:
+            self._fenced[self._epoch] = old
+        self._epoch += 1
+        self._fp_cache = None
+        eng, new_fp = self._engine_and_fp()  # new state resident now
+        out = {"epoch": self._epoch, "wholesale": footprint is None,
+               "hot_rekeyed": 0, "hot_dropped": 0,
+               "disk_rekeyed": 0, "disk_dropped": 0}
+        touched = getattr(footprint, "touched", footprint)
+        if touched is None:
+            if old is not None:
+                self.cache.invalidate()
+        elif old is not None and old[1] != new_fp:
+            hot = self.cache.rekey(old[1], new_fp, touched)
+            out["hot_rekeyed"] = hot["rekeyed"]
+            out["hot_dropped"] = hot["dropped"]
+            d = self._disk_dir(eng)
+            if d is not None:
+                disk = scache.disk_rekey(
+                    d, eng.model_name, eng.solver, old[1], new_fp,
+                    touched, stats=self.cache.stats,
+                )
+                out["disk_rekeyed"] = disk["rekeyed"]
+                out["disk_dropped"] = disk["dropped"]
+        self.metrics.record_swap(**out)
+        return out
 
     # -- request intake ----------------------------------------------------
     def submit(self, req: Request) -> Response | None:
@@ -236,7 +302,9 @@ class InfluenceService:
             )
             self.metrics.record_request(resp)
             return resp
-        self._queue.append(self.admission.ticket(req, self.clock()))
+        t = self.admission.ticket(req, self.clock())
+        t.epoch = self._epoch
+        self._queue.append(t)
         return None
 
     @property
@@ -245,21 +313,46 @@ class InfluenceService:
 
     # -- the drain loop ----------------------------------------------------
     def drain(self) -> list[Response]:
-        """Resolve every queued ticket (see module docstring)."""
+        """Resolve every queued ticket (see module docstring).
+
+        Tickets are grouped by admission epoch and each group resolves
+        against that epoch's fenced (engine, fingerprint) — a streaming
+        update between submit and drain never changes what an in-flight
+        ticket answers from. The current epoch (and any epoch whose
+        fence was dropped by a wholesale invalidation) resolves against
+        the live engine. The fence table is cleared afterwards: the
+        service is synchronous, so the queue that referenced the old
+        epochs is fully consumed here.
+        """
         if not self._queue:
             return []
         work, self._queue = self._queue, []
-        eng, fp = self._engine_and_fp()
         now = self.clock()
 
         responses: dict[int, Response] = {}  # queue position -> response
-        live: list[tuple[int, Ticket]] = []
+        by_epoch: dict[int, list[tuple[int, Ticket]]] = {}
         for pos, t in enumerate(work):
             if t.expired(now):
                 responses[pos] = self._reject(t, REASON_DEADLINE, now)
             else:
-                live.append((pos, t))
+                by_epoch.setdefault(t.epoch, []).append((pos, t))
 
+        for epoch in sorted(by_epoch):
+            fenced = (self._fenced.get(epoch)
+                      if epoch != self._epoch else None)
+            eng, fp = (fenced if fenced is not None
+                       else self._engine_and_fp())
+            self._resolve_group(eng, fp, by_epoch[epoch], responses)
+        self._fenced.clear()
+
+        out = [responses[pos] for pos in sorted(responses)]
+        for r in out:
+            self.metrics.record_request(r)
+        return out
+
+    def _resolve_group(self, eng, fp, live, responses) -> None:
+        """Resolve one epoch group of live tickets against (eng, fp)."""
+        now = self.clock()
         # cache tiers first; misses keep first-arrival order per key
         misses: dict[tuple, list[tuple[int, Ticket]]] = {}
         for pos, t in live:
@@ -277,11 +370,6 @@ class InfluenceService:
 
         if misses:
             self._dispatch_misses(eng, fp, misses, responses)
-
-        out = [responses[pos] for pos in sorted(responses)]
-        for r in out:
-            self.metrics.record_request(r)
-        return out
 
     def _overlap_eligible(self, eng) -> bool:
         """Windowed dispatch applies only where query_batch would run

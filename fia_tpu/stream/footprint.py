@@ -1,0 +1,98 @@
+"""Touched-block footprint of a streaming update.
+
+An appended interaction batch directly perturbs the users ΔU and items
+ΔI it names (their embedding rows fine-tune, their interaction lists
+grow). But the (u, i) *influence block* reads more than u's and i's own
+rows: the block Hessian gathers the P/Q rows of every counterparty in
+the pair's related set (``factor.dep_crcs`` documents the exact read
+set). So the blocks an update can reach are:
+
+- ``user_touched[u]``: u ∈ ΔU, or u has an interaction with an item in
+  ΔI (that item's Q row — which u's block Hessian reads — moved);
+- ``item_touched[i]``: i ∈ ΔI, or i has an interaction with a user in
+  ΔU;
+- block (u, i) is touched iff ``user_touched[u] | item_touched[i]``.
+
+Everything outside this footprint reads only parameter rows and train
+rows the update provably did not change (the projection in
+``stream.update`` pins them bit-identically), so untouched cache
+entries can be re-keyed to the new params fingerprint without
+recompute — the basis of surgical invalidation across the serve tiers.
+
+The masks are computed over the OLD train set: appended rows connect
+ΔU users only to ΔI items, both already first-order touched, so they
+add no second-order reach beyond what the old adjacency gives.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+
+@dataclass
+class Footprint:
+    """Boolean touch masks over the user/item id spaces."""
+
+    user_touched: np.ndarray  # (num_users,) bool
+    item_touched: np.ndarray  # (num_items,) bool
+    delta_users: np.ndarray  # unique user ids named by the update
+    delta_items: np.ndarray  # unique item ids named by the update
+
+    def touched(self, user: int, item: int) -> bool:
+        """Whether the (user, item) influence block is in the footprint."""
+        return bool(self.user_touched[int(user)]) or bool(
+            self.item_touched[int(item)]
+        )
+
+    def touched_pairs(self, pairs: np.ndarray) -> np.ndarray:
+        """(N,) bool mask for an (N, 2) array of (user, item) pairs."""
+        p = np.asarray(pairs, np.int64)
+        return self.user_touched[p[:, 0]] | self.item_touched[p[:, 1]]
+
+    @property
+    def num_touched_users(self) -> int:
+        return int(np.count_nonzero(self.user_touched))
+
+    @property
+    def num_touched_items(self) -> int:
+        return int(np.count_nonzero(self.item_touched))
+
+
+def compute_footprint(train_x, new_x, num_users: int,
+                      num_items: int) -> Footprint:
+    """The touched-block footprint of appending ``new_x`` to ``train_x``.
+
+    ``train_x``: (N, 2) old interaction ids; ``new_x``: (M, 2) appended
+    ids. Pure vectorized numpy — two scatter passes and two bincounts,
+    no index structure required.
+    """
+    x = np.asarray(train_x, np.int64).reshape(-1, 2)
+    nx = np.asarray(new_x, np.int64).reshape(-1, 2)
+    du = np.unique(nx[:, 0])
+    di = np.unique(nx[:, 1])
+
+    in_du = np.zeros(int(num_users), bool)
+    in_du[du] = True
+    in_di = np.zeros(int(num_items), bool)
+    in_di[di] = True
+
+    # second-order reach through the old adjacency: a user is touched if
+    # any of its rows names a ΔI item (it reads that item's moved Q
+    # row); symmetrically for items.
+    rows_hit_item = in_di[x[:, 1]]
+    user_indirect = (
+        np.bincount(x[rows_hit_item, 0], minlength=int(num_users)) > 0
+    )
+    rows_hit_user = in_du[x[:, 0]]
+    item_indirect = (
+        np.bincount(x[rows_hit_user, 1], minlength=int(num_items)) > 0
+    )
+
+    return Footprint(
+        user_touched=in_du | user_indirect,
+        item_touched=in_di | item_indirect,
+        delta_users=du,
+        delta_items=di,
+    )

@@ -1,0 +1,58 @@
+#!/usr/bin/env bash
+# Churn smoke: serving under online model updates (docs/design.md §17).
+# Runs the bench churn mode (`bench.py serve --churn --quick`): a
+# serving stream with TWO mid-stream `FIAModel.apply_updates` calls,
+# then asserts on its JSON artifact:
+#   - zero stale hits: every post-swap hot-set response byte-matches a
+#     fresh compute on the live engine (churn AND wholesale phases)
+#   - surgical invalidation: the two updates (each confined to one of
+#     25 communities) recompute at most the 5% touched footprint, and
+#     the hot/disk re-key counters actually moved
+#   - bounded staleness window: each epoch-fenced swap (fine-tune done
+#     -> new warm engine serving) completes within 10s on CPU
+#
+#   bash scripts/churn_smoke.sh        (or: make churn-smoke)
+#
+# Budget: <60s on CPU — tiny community-structured MF, 300 training
+# steps, 40-step incremental updates. The train dir, serve disk tier
+# and metrics JSONL land in a throwaway tmpdir via the bench.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+DIR=$(mktemp -d /tmp/fia_churn_smoke.XXXXXX)
+trap 'rm -rf "$DIR"' EXIT
+
+JAX_PLATFORMS=cpu timeout -k 10 300 python bench.py serve --churn \
+  --quick --json_out "$DIR/churn.json" > "$DIR/stdout.log"
+
+python - "$DIR/churn.json" <<'EOF'
+import json
+import sys
+
+d = json.load(open(sys.argv[1]))["details"]
+churn, whole = d["churn"], d["wholesale"]
+hot, acc = d["hot_blocks"], d["surgical_accounting"]
+updates = churn["updates"]
+
+assert len(updates) == 2, f"expected 2 mid-stream updates, got {len(updates)}"
+assert churn["stale_hits"] == 0, f"stale hits under churn: {churn['stale_hits']}"
+assert whole["stale_hits"] == 0, f"stale hits under wholesale: {whole['stale_hits']}"
+
+# surgical: <=5% of hot blocks recompute per update, never the lot
+budget = max(1, int(0.05 * hot)) * len(updates)
+got = churn["hot_recomputes_after_update"]
+assert got <= budget, f"recomputed {got} hot blocks (budget {budget})"
+assert got < whole["hot_recomputes_after_update"], \
+    "surgical invalidation recomputed as much as a wholesale flush"
+assert acc["hot_rekeyed"] > 0 and acc["disk_rekeyed"] > 0, \
+    f"re-key counters never moved: {acc}"
+
+for u in updates:
+    assert u["staleness_ms"] < 10_000, \
+        f"staleness window {u['staleness_ms']}ms exceeds the 10s bound"
+
+print(f"churn-smoke PASS: {len(updates)} updates, "
+      f"{got}/{hot * len(updates)} hot recomputes (wholesale "
+      f"{whole['hot_recomputes_after_update']}), 0 stale hits, "
+      f"staleness {[u['staleness_ms'] for u in updates]} ms")
+EOF

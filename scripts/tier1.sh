@@ -38,6 +38,14 @@ bash scripts/multichip_smoke.sh || {
   echo "multichip-smoke FAILED (run make multichip-smoke)"
   exit 1
 }
+# Churn smoke, FATAL: serving under online model updates — two
+# mid-stream apply_updates with zero stale hits, a surgical (<=5%)
+# recompute footprint vs the wholesale baseline, and a bounded
+# epoch-fence staleness window (docs/design.md §17).
+bash scripts/churn_smoke.sh || {
+  echo "churn-smoke FAILED (run make churn-smoke)"
+  exit 1
+}
 # Serving smoke next, NON-fatal: the pinned tier-1 verdict below stays
 # exactly the ROADMAP.md pytest command, the smoke just surfaces
 # serving regressions in the same log.

@@ -1,0 +1,281 @@
+"""Streaming updates (fia_tpu/stream + the serve-layer epoch fence):
+
+- footprint: the touched set matches the cross-user Hessian read set
+  (second-order reach through shared users/items), symmetric both ways.
+- projection: fine-tuned rows outside the footprint (and every global
+  leaf) are pinned to their pre-update bytes.
+- apply_updates: an epoch-fenced commit answers in-flight tickets on
+  their admission state, surgically re-keys untouched hot/disk entries
+  (never a wholesale flush), resumes a killed attempt bit-identically,
+  and rolls back on a classified swap failure with serving intact.
+"""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from fia_tpu.api import FIAModel
+from fia_tpu.data.dataset import RatingDataset
+from fia_tpu.models import MF
+from fia_tpu.reliability import inject, sites, taxonomy
+from fia_tpu.reliability import policy as rpolicy
+from fia_tpu.serve import InfluenceService, Request, ServeConfig
+from fia_tpu.stream import compute_footprint, project_params
+from fia_tpu.stream.footprint import Footprint
+
+U, I, K = 30, 20, 4
+WD = 1e-2
+DAMP = 1e-3
+STEPS = 8  # fine-tune steps per update in these tests
+
+# community A: users 0-14 x items 0-9; community B: the rest. Updates
+# land in A, so B pairs are provably outside every footprint.
+TOUCHED_PAIR = (2, 3)
+UNTOUCHED_PAIR = (22, 17)
+UPD_X = np.array([[2, 3], [5, 1], [11, 8]], np.int32)
+UPD_Y = np.array([5.0, 4.0, 3.0], np.float32)
+
+
+def _community_data(seed=0, n=240):
+    rng = np.random.default_rng(seed)
+    half = n // 2
+    xa = np.stack([rng.integers(0, 15, half),
+                   rng.integers(0, 10, half)], axis=1)
+    xb = np.stack([rng.integers(15, U, n - half),
+                   rng.integers(10, I, n - half)], axis=1)
+    x = np.concatenate([xa, xb]).astype(np.int32)
+    y = rng.integers(1, 6, n).astype(np.float32)
+    return x, y
+
+
+def _params_bytes(tree) -> bytes:
+    return b"".join(
+        np.ascontiguousarray(leaf).tobytes()
+        for leaf in jax.tree_util.tree_leaves(
+            jax.tree_util.tree_map(np.asarray, tree))
+    )
+
+
+@pytest.fixture(scope="module")
+def base_model(tmp_path_factory):
+    """One trained FIAModel shared across tests (compiles paid once);
+    the ``fm`` fixture snapshots/restores its state around each test."""
+    x, y = _community_data()
+    m = FIAModel(
+        "MF", U, I, K, WD, batch_size=50,
+        data_sets={"train": RatingDataset(x, y)},
+        initial_learning_rate=1e-2, damping=DAMP,
+        train_dir=str(tmp_path_factory.mktemp("stream-base")),
+        model_name="stream-test", solver="direct", seed=0,
+    )
+    m._trainer.clock = rpolicy.VirtualClock()
+    m.train(24, save_checkpoints=False, verbose=False)
+    return m
+
+
+@pytest.fixture()
+def fm(base_model, tmp_path):
+    saved = (base_model.state, base_model.data_sets["train"],
+             base_model.train_dir)
+    base_model.train_dir = str(tmp_path)
+    yield base_model
+    (base_model.state, base_model.data_sets["train"],
+     base_model.train_dir) = saved
+    base_model._engines.clear()
+
+
+def _service(fm):
+    return InfluenceService.from_model(
+        fm, config=ServeConfig(), clock=rpolicy.VirtualClock())
+
+
+def _one(svc, pair, rid="q"):
+    r = svc.run([Request(pair[0], pair[1], id=rid)], drain_every=1)[0]
+    assert r.ok, (r.status, r.reason)
+    return r
+
+
+class TestFootprint:
+    def test_second_order_reach_matches_hessian_read_set(self):
+        # rows: u0-i0, u1-i0, u2-i1; update adds u0-i1
+        train_x = np.array([[0, 0], [1, 0], [2, 1]], np.int32)
+        fp = compute_footprint(train_x, np.array([[0, 1]], np.int32), 5, 4)
+        # u0 (direct), u2 (shares i1); i1 (direct), i0 (shared by u0)
+        assert set(np.flatnonzero(fp.user_touched)) == {0, 2}
+        assert set(np.flatnonzero(fp.item_touched)) == {0, 1}
+        # u1 reads i0's column, so any (u1, *) block with i0 is touched
+        assert fp.touched(1, 0)
+        # but u1 against an untouched item is not
+        assert not fp.touched(1, 2)
+        assert not fp.touched(3, 3)
+
+    def test_touched_pairs_vectorized_matches_scalar(self):
+        x, y = _community_data(n=60)
+        fp = compute_footprint(x, UPD_X, U, I)
+        pairs = np.stack([np.repeat(np.arange(U), I),
+                          np.tile(np.arange(I), U)], axis=1)
+        mask = fp.touched_pairs(pairs)
+        for (u, i), m in zip(pairs[::17], mask[::17]):
+            assert m == fp.touched(u, i)
+        # community B never touched
+        assert not fp.touched(*UNTOUCHED_PAIR)
+
+    def test_projection_pins_untouched_rows_and_globals(self):
+        model = MF(U, I, K, WD)
+        old = jax.tree_util.tree_map(
+            np.asarray, model.init_params(jax.random.PRNGKey(0)))
+        new = jax.tree_util.tree_map(lambda a: np.asarray(a) + 1.0, old)
+        fp = Footprint(
+            user_touched=np.arange(U) < 3,
+            item_touched=np.arange(I) < 2,
+            delta_users=np.arange(3), delta_items=np.arange(2),
+        )
+        proj = project_params(model, old, new, fp)
+        leaves = {k: np.asarray(v) for k, v in proj.items()}
+        assert np.array_equal(leaves["P"][:3], np.asarray(new["P"])[:3])
+        assert np.array_equal(leaves["P"][3:], np.asarray(old["P"])[3:])
+        assert np.array_equal(leaves["Q"][:2], np.asarray(new["Q"])[:2])
+        assert np.array_equal(leaves["Q"][2:], np.asarray(old["Q"])[2:])
+        # the global bias never moves under a projected update
+        assert np.array_equal(leaves["bg"], np.asarray(old["bg"]))
+
+
+class TestEpochFencedCommit:
+    def test_inflight_ticket_answers_on_admission_epoch(self, fm):
+        svc = _service(fm)
+        old_bytes = np.asarray(
+            _one(svc, TOUCHED_PAIR, "warm").scores).tobytes()
+        assert svc.submit(Request(*TOUCHED_PAIR, id="inflight")) is None
+
+        r = fm.apply_updates(UPD_X, UPD_Y, steps=STEPS,
+                             checkpoint_every=4)
+        assert r.committed and r.status == "committed"
+        assert svc.epoch == 1
+
+        inflight = next(x for x in svc.drain() if x.id == "inflight")
+        assert inflight.ok
+        # admitted before the swap -> answered from the fenced old state
+        assert np.asarray(inflight.scores).tobytes() == old_bytes
+        # the same pair queried now answers from the NEW state
+        new_bytes = np.asarray(
+            _one(svc, TOUCHED_PAIR, "after").scores).tobytes()
+        assert new_bytes != old_bytes
+
+    def test_surgical_rekey_not_wholesale_flush(self, fm):
+        svc = _service(fm)
+        old_untouched = np.asarray(
+            _one(svc, UNTOUCHED_PAIR, "b").scores).tobytes()
+        _one(svc, TOUCHED_PAIR, "a")
+        inv_before = svc.cache.stats.invalidations
+
+        assert fm.apply_updates(UPD_X, UPD_Y, steps=STEPS).committed
+        st = svc.cache.stats
+        # the untouched hot entry rode through by re-keying; the touched
+        # one was dropped; nothing was wholesale-flushed
+        assert st.rekeyed >= 1
+        assert st.rekey_dropped >= 1
+        assert st.invalidations == inv_before
+        assert st.disk_rekeyed >= 1
+        assert st.disk_rekey_dropped >= 1
+        assert len(svc.cache) >= 1
+
+        r = _one(svc, UNTOUCHED_PAIR, "b2")
+        assert r.cache_tier == "hot"  # re-keyed entry, no recompute
+        assert np.asarray(r.scores).tobytes() == old_untouched
+
+    def test_wholesale_invalidation_still_available(self, fm):
+        svc = _service(fm)
+        _one(svc, UNTOUCHED_PAIR, "b")
+        out = svc.advance_epoch(None)  # no footprint -> wholesale
+        assert out["wholesale"] is True
+        assert len(svc.cache) == 0
+        assert svc.cache.stats.invalidations >= 1
+
+    def test_metrics_jsonl_carries_update_and_swap(self, fm, tmp_path):
+        path = str(tmp_path / "metrics.jsonl")
+        svc = InfluenceService.from_model(
+            fm, config=ServeConfig(metrics_path=path),
+            clock=rpolicy.VirtualClock())
+        _one(svc, UNTOUCHED_PAIR, "b")
+        assert fm.apply_updates(UPD_X, UPD_Y, steps=STEPS).committed
+        svc.metrics.close()
+        import json
+
+        events = [json.loads(ln) for ln in open(path)]
+        upd = next(e for e in events if e["event"] == "stream.update")
+        assert upd["status"] == "committed" and upd["new_rows"] == 3
+        swap = next(e for e in events if e["event"] == "stream.swap")
+        assert swap["epoch"] == 1 and swap["wholesale"] is False
+        assert swap["hot_rekeyed"] >= 1
+
+
+class TestCrashSafety:
+    def test_kill_resume_bit_identical_to_uninterrupted(self, fm):
+        # clean reference first (same trainer, no recompiles)
+        base_state, base_train = fm.state, fm.data_sets["train"]
+        clean = fm.apply_updates(UPD_X, UPD_Y, steps=STEPS,
+                                 checkpoint_every=2)
+        assert clean.committed
+        clean_bytes = _params_bytes(fm.state.params)
+
+        fm.state, fm.data_sets["train"] = base_state, base_train
+        fm._engines.clear()
+        # the 8-step fine-tune runs 2 epoch dispatches (5 + 3 steps at
+        # batch 50 over 240 rows): kill the second, after a checkpoint
+        with inject.active(inject.Fault(sites.TRAINER_EPOCH, at=1,
+                                        kind=taxonomy.OOM)):
+            killed = fm.apply_updates(UPD_X, UPD_Y, steps=STEPS,
+                                      checkpoint_every=2)
+        assert killed.status == "rolled_back"
+        assert killed.reason == taxonomy.OOM
+        assert _params_bytes(fm.state.params) == _params_bytes(
+            base_state.params)
+        # the killed attempt left rotated checkpoints behind
+        ckpt_dir = os.path.join(fm.train_dir, "stream",
+                                f"upd-{killed.update_id}")
+        assert os.path.isdir(ckpt_dir)
+
+        resumed = fm.apply_updates(UPD_X, UPD_Y, steps=STEPS,
+                                   checkpoint_every=2)
+        assert resumed.committed
+        assert resumed.update_id == killed.update_id
+        assert resumed.resumed_step is not None
+        assert resumed.resumed_step > int(base_state.step)
+        assert _params_bytes(fm.state.params) == clean_bytes
+        assert not os.path.isdir(ckpt_dir)  # cleaned after commit
+
+    def test_rollback_on_classified_swap_failure(self, fm):
+        svc = _service(fm)
+        old_bytes = np.asarray(
+            _one(svc, TOUCHED_PAIR, "warm").scores).tobytes()
+        base_bytes = _params_bytes(fm.state.params)
+
+        with inject.active(inject.Fault(sites.STREAM_SWAP, at=0,
+                                        kind=taxonomy.PREEMPTION)):
+            r = fm.apply_updates(UPD_X, UPD_Y, steps=STEPS)
+        assert r.status == "rolled_back"
+        assert r.reason == taxonomy.PREEMPTION
+        # no half-swap: params, train set, epoch, serving all old-state
+        assert _params_bytes(fm.state.params) == base_bytes
+        assert fm.data_sets["train"].num_examples == 240
+        assert svc.epoch == 0
+        again = np.asarray(
+            _one(svc, TOUCHED_PAIR, "after").scores).tobytes()
+        assert again == old_bytes
+
+    def test_update_site_failure_rolls_back_before_any_work(self, fm):
+        with inject.active(inject.Fault(sites.STREAM_UPDATE, at=0,
+                                        kind=taxonomy.WORKER)):
+            r = fm.apply_updates(UPD_X, UPD_Y, steps=STEPS)
+        assert r.status == "rolled_back"
+        assert r.reason == taxonomy.WORKER
+
+    def test_bad_ids_rejected(self, fm):
+        with pytest.raises(ValueError):
+            fm.apply_updates(np.array([[U, 0]], np.int32),
+                             np.array([1.0], np.float32))
+        with pytest.raises(ValueError):
+            fm.apply_updates(np.zeros((0, 2), np.int32),
+                             np.zeros(0, np.float32))
