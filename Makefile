@@ -1,7 +1,7 @@
 # Convenience targets. The commands themselves are pinned in
 # ROADMAP.md (tier-1) and scripts/ — these targets just name them.
 
-.PHONY: tier1 test lint lint-io serve-smoke multichip-smoke factor-smoke chaos-smoke chaos-soak churn-smoke
+.PHONY: tier1 test lint lint-io serve-smoke multichip-smoke factor-smoke chaos-smoke chaos-soak churn-smoke degraded-smoke
 
 # The ROADMAP.md tier-1 verify: fast CPU suite, slow tests excluded.
 # Lint is fatal — a finding fails the build before pytest runs.
@@ -56,6 +56,14 @@ chaos-smoke:
 # bounded epoch-fence staleness window (docs/design.md §17).
 churn-smoke:
 	bash scripts/churn_smoke.sh
+
+# Degraded smoke: the r12 survival paths on CPU (<60s, 8 virtual
+# devices) — one forced device loss (4-device mesh shrinks to 3,
+# stream bit-identical to a single-device reference) and one brownout
+# episode (ladder to bank_preferred, bank hits byte-identical, misses
+# shed `degraded`, recovery to full). docs/design.md §18.
+degraded-smoke:
+	bash scripts/degraded_smoke.sh
 
 # Chaos soak: a seed-range sweep over the FULL fault domain (kill
 # kinds, NaN payloads, deadlines) — the fuzz mode; not part of tier-1.

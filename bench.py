@@ -975,6 +975,21 @@ def serve_main():
         multi_device = {"error": repr(e)}
 
     unreasoned = sum(1 for r in responses if not r.ok and not r.reason)
+    from fia_tpu.serve import (
+        REASON_DEADLINE,
+        REASON_DEGRADED,
+        REASON_INVALID,
+        REASON_OVERLOAD,
+    )
+
+    # the canonical rejection-reason histogram: always all four
+    # reasons, zeros included — dashboards difference these counters,
+    # and a key that appears only when nonzero breaks that
+    rejected_by_reason = {
+        r: roll["rejected"].get(r, 0)
+        for r in (REASON_OVERLOAD, REASON_INVALID, REASON_DEADLINE,
+                  REASON_DEGRADED)
+    }
     out = {
         "metric": "fia-serve sustained qps (open loop @1.2x capacity)",
         "value": round(roll["ok"] / wall, 2),
@@ -986,6 +1001,9 @@ def serve_main():
             "requests": n_req,
             "ok": roll["ok"],
             "rejected": roll["rejected"],
+            "rejected_by_reason": rejected_by_reason,
+            "modes": roll["modes"],
+            "mode_transitions": roll["mode_transitions"],
             "dropped_unreasoned": unreasoned,
             "hot_hit_rate": roll["hot_hit_rate"],
             "tiers": roll["tiers"],

@@ -453,8 +453,9 @@ class ServeStreamScenario(Scenario):
         allowed = {
             taxonomy.OOM, taxonomy.HOST_OOM, taxonomy.AMBIGUOUS,
             taxonomy.WORKER, taxonomy.PREEMPTION, taxonomy.NAN,
-            taxonomy.DEADLINE,
+            taxonomy.DEADLINE, taxonomy.DEVICE_LOST,
             admission.REASON_OVERLOAD, admission.REASON_INVALID,
+            admission.REASON_DEGRADED,
         }
         for name, g in golden.items():
             if name.endswith(":status"):
@@ -568,6 +569,143 @@ class ServeStreamMeshScenario(ServeStreamScenario):
                         "the single-device reference (sharded dispatch "
                         "must be bit-identical)",
                     ))
+        return failures
+
+
+class DeviceLossRecoveryScenario(ServeStreamScenario):
+    """Kill mesh devices mid-wave; the service must shrink and recover.
+
+    The serve_stream workload over a 4-device ``data`` mesh, with
+    ``device_lost`` faults armed at ``serve.dispatch``. Each loss must
+    trigger the mesh-shrink recovery (docs/design.md §18): rebuild over
+    the survivors, AOT re-arm, re-dispatch the failed batch — so a
+    BENIGN schedule of losses sheds *nothing* and reproduces the golden
+    stream bit-identically, through up to three consecutive shrinks
+    (4 → 3 → 2 → 1 devices; ``max_at=3`` keeps every benign schedule
+    within what four devices can absorb). Scenario oracles:
+
+    - ``shrunk_mesh_identity`` — every score served, golden AND chaos,
+      matches a fault-free single-device reference bit-for-bit; the
+      mesh size the run ended on must never show through in results.
+    - ``no_unclassified_errors`` — a run may die or shed, but only
+      classified: an unclassified escape or rejection reason means the
+      recovery path leaked a raw backend error.
+
+    Degrades to the meshless workload when fewer than 4 devices exist
+    (``mesh_skipped`` event): ``device_lost`` then has nothing to
+    shrink and sheds classified, so it moves to the FULL domain only.
+    """
+
+    name = "device_loss_recovery"
+    NDEV = 4
+
+    def __init__(self):
+        super().__init__()
+        import jax
+
+        from fia_tpu.influence.engine import InfluenceEngine
+        from fia_tpu.parallel.mesh import make_mesh
+        from fia_tpu.serve.request import Request
+        from fia_tpu.serve.service import InfluenceService, ServeConfig
+
+        # fault-free single-device reference stream (same pattern as
+        # serve_stream_mesh: computed before any schedule is armed)
+        ref_svc = InfluenceService(
+            engine=self.engine,
+            config=ServeConfig(max_batch=self.MAX_BATCH,
+                               max_queue=self.MAX_QUEUE),
+            clock=rpolicy.VirtualClock(),
+        )
+        reqs = [Request(u, i, id=f"q{n}")
+                for n, (u, i) in enumerate(self._stream())]
+        self.ref = {
+            r.id: np.asarray(r.scores).copy()
+            for r in ref_svc.run(reqs, drain_every=self.WAVE) if r.ok
+        }
+        if jax.device_count() >= self.NDEV:
+            self.mesh = make_mesh(self.NDEV)
+            self.engine = InfluenceEngine(
+                self.model, self.params, self.train_ds, damping=_DAMP,
+                model_name="chaos-devloss", mesh=self.mesh)
+        # Domains are per-instance: device loss is benign (recovery is
+        # a bit-identical re-dispatch) only when there is a mesh to
+        # shrink. mesh.rebuild is deliberately NOT in any domain — the
+        # site is reachable only after a loss fires, so arming it
+        # directly would violate the armed ⇒ fired contract;
+        # rebuild-time faults are unit-tested instead.
+        if self.mesh is not None:
+            self.benign_domain = {
+                sites.SERVE_DISPATCH: ((taxonomy.DEVICE_LOST,), 3),
+                sites.SERVE_CACHE_PUBLISH: (_DAMAGE_KINDS, 10),
+            }
+        else:
+            self.benign_domain = dict(ServeStreamScenario.benign_domain)
+        self.full_domain = {
+            sites.SERVE_DISPATCH: (
+                (taxonomy.WORKER, taxonomy.OOM, taxonomy.DEADLINE,
+                 taxonomy.DEVICE_LOST), 4),
+            sites.SERVE_CACHE_PUBLISH: (_DAMAGE_KINDS, 1),
+            sites.CHAOS_SCENARIO: ((taxonomy.WORKER,), 1),
+        }
+
+    def run(self, workdir: str, events: list) -> dict:
+        import jax
+
+        from fia_tpu.parallel.mesh import mesh_fingerprint
+
+        if self.mesh is None:
+            events.append({"event": "mesh_skipped",
+                           "devices": int(jax.device_count())})
+        elif (mesh_fingerprint(self.engine.mesh)
+                != mesh_fingerprint(self.mesh)):
+            # a prior run's recovery left the shared engine on a shrunk
+            # mesh; restore the full topology so every run starts equal
+            self.engine.rebuild_mesh(self.mesh)
+        out = super().run(workdir, events)
+        if self.mesh is not None:
+            # recovery accounting goes in events, NOT the outcome — the
+            # golden run never shrinks, and benign runs must stay
+            # bit-identical to it in outcome space
+            events.append({
+                "event": "mesh_after",
+                "devices": int(self.engine.mesh.devices.size),
+                "shrunk": int(self.NDEV - self.engine.mesh.devices.size),
+            })
+        return out
+
+    def check(self, golden: dict, record) -> list:
+        from fia_tpu.chaos.oracles import OracleFailure
+
+        failures = super().check(golden, record)
+        if record.error is not None and record.error.get("kind") is None:
+            failures.append(OracleFailure(
+                "no_unclassified_errors",
+                f"run died unclassified: {record.error.get('error')}",
+            ))
+        outcomes = [("golden", golden)]
+        if record.error is None and record.outcome is not None:
+            outcomes.append(("chaos", record.outcome))
+        for label, out in outcomes:
+            for name, v in out.items():
+                # rejection-reason classification is covered by the
+                # parent's classified_rejection oracle; here: identity
+                if name.endswith(":scores"):
+                    rid = name[: -len(":scores")]
+                    ref = self.ref.get(rid)
+                    if ref is None:
+                        failures.append(OracleFailure(
+                            "shrunk_mesh_identity",
+                            f"{label} run served {rid}, which the "
+                            "single-device reference rejected",
+                        ))
+                    elif not np.array_equal(np.asarray(v), ref):
+                        failures.append(OracleFailure(
+                            "shrunk_mesh_identity",
+                            f"{label} run: scores for {rid} diverge "
+                            "from the fault-free single-device "
+                            "reference (mesh-shrink recovery must be "
+                            "bit-identical)",
+                        ))
         return failures
 
 
@@ -968,6 +1106,7 @@ def make_scenarios() -> dict:
         QueryCacheScenario.name: QueryCacheScenario,
         ServeStreamScenario.name: ServeStreamScenario,
         ServeStreamMeshScenario.name: ServeStreamMeshScenario,
+        DeviceLossRecoveryScenario.name: DeviceLossRecoveryScenario,
         FactorBankScenario.name: FactorBankScenario,
         UpdateWhileServingScenario.name: UpdateWhileServingScenario,
     }

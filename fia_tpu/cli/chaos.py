@@ -38,7 +38,8 @@ from fia_tpu.chaos.scenarios import SCENARIO_NAMES
 # forces 8 virtual CPU devices); on a 1-device host it degrades to the
 # single-device workload rather than failing.
 SMOKE_SCENARIOS = ("selftest", "train_resume", "query_cache",
-                   "serve_stream", "serve_stream_mesh", "factor_bank",
+                   "serve_stream", "serve_stream_mesh",
+                   "device_loss_recovery", "factor_bank",
                    "update_while_serving")
 SMOKE_SEEDS_PER_SCENARIO = 2
 SMOKE_FAULTS = 3

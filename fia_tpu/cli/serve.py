@@ -38,6 +38,7 @@ import numpy as np
 
 from fia_tpu.cli import common
 from fia_tpu.influence.engine import InfluenceEngine
+from fia_tpu.reliability import taxonomy
 from fia_tpu.serve import InfluenceService, Request, ServeConfig
 
 
@@ -111,7 +112,19 @@ def build_service(args):
         disk_cache=bool(args.disk_cache), metrics_path=metrics,
         mesh=mesh,
     )
-    svc = InfluenceService(engine=engine, config=cfg)
+    try:
+        svc = InfluenceService(engine=engine, config=cfg)
+    except Exception as e:
+        # construction validates mesh liveness + fingerprint; report a
+        # classified failure as an operator-readable line and a clean
+        # nonzero exit, never a raw backend traceback
+        kind = taxonomy.classify(e)
+        if kind is None:
+            raise
+        print(json.dumps({"event": "serve.construct_failed",
+                          "kind": kind, "error": str(e)}),
+              file=sys.stderr)
+        raise SystemExit(1)
     return svc, splits
 
 

@@ -494,6 +494,53 @@ class InfluenceEngine:
             deadline=rpolicy.Deadline(max_wait_s),
         )
 
+    def rebuild_mesh(self, mesh, max_wait_s: float = 120.0) -> None:
+        """Re-home the engine on a different (usually shrunken) mesh.
+
+        The ``device_lost`` recovery move: unlike a worker crash
+        (:meth:`_reset_device_state`, same topology), the dead device is
+        not coming back — the service hands us the surviving mesh
+        (:func:`fia_tpu.parallel.mesh.surviving_mesh`) and we re-place
+        every device-resident tensor on it from the host copies. Every
+        compiled executable is dropped: AOT keys embed the mesh
+        fingerprint (:meth:`_aot_key`), so the caller re-arms the
+        planned geometries with :meth:`precompile_flat` and steady
+        state stays zero-compile on the new topology. Results are
+        unchanged by construction — ``_mesh_plan`` gives each shard the
+        exact single-device program, so scores are bit-identical across
+        mesh sizes (docs/design.md §15).
+
+        Passing ``mesh=None`` re-homes onto the default single device —
+        the last rung before giving up entirely.
+        """
+        inject.fire(sites.MESH_REBUILD)
+        self.mesh = mesh
+        self._multihost = False
+        if mesh is not None:
+            from fia_tpu.parallel.distributed import spans_processes
+
+            self._multihost = spans_processes(mesh)
+        self._jitted.clear()
+        self._aot.clear()
+        # Survivor devices may themselves be settling after the fabric
+        # event — re-placement retries under the same envelope as the
+        # worker-restart path.
+        pol = rpolicy.RetryPolicy(
+            max_attempts=8, base_delay=2.0, max_delay=30.0, jitter=0.25
+        )
+        pol.run(
+            self._upload_device_state,
+            retry_on=(taxonomy.WORKER, taxonomy.PREEMPTION),
+            deadline=rpolicy.Deadline(max_wait_s),
+        )
+        if self._bank is not None:
+            self._bank_device = (
+                jnp.asarray(self._bank.factor),
+                jnp.asarray(self._bank.kind.astype(np.int32)),
+            )
+        if self._bank_delegate is not None:
+            self._bank_delegate.rebuild_mesh(mesh, max_wait_s=max_wait_s)
+
     # -- the pure per-test-point query ------------------------------------
     def _query_one(self, params, train_x, train_y, postings, u, i, test_x,
                    *, pad: int):

@@ -53,6 +53,58 @@ def mesh_fingerprint(mesh: Mesh | None):
     )
 
 
+def live_device_ids() -> frozenset:
+    """Ids of the devices the backend can see right now.
+
+    The liveness baseline for device-loss handling: a mesh referencing
+    an id outside this set is serving on a dead device. On a healthy
+    host this is just ``jax.devices()``; when the backend itself is
+    unreachable the empty set is returned (every mesh device then
+    counts as lost, which is the honest answer).
+    """
+    try:
+        return frozenset(int(d.id) for d in jax.devices())
+    except Exception:
+        return frozenset()
+
+
+def lost_device_ids(mesh: Mesh | None) -> tuple[int, ...]:
+    """Mesh device ids no longer visible to the backend (sorted)."""
+    if mesh is None:
+        return ()
+    live = live_device_ids()
+    return tuple(sorted(
+        int(d.id) for d in mesh.devices.flat if int(d.id) not in live
+    ))
+
+
+def surviving_mesh(mesh: Mesh, lost_ids=()) -> Mesh | None:
+    """The shrunk mesh after device loss: survivors, original order.
+
+    ``lost_ids``: device ids known dead (:func:`lost_device_ids`). When
+    empty — a dispatch fault classified ``device_lost`` without naming
+    the culprit, the common case for injected losses and terse backend
+    errors — the LAST mesh device is dropped: deterministic, and the
+    *identity* of the dropped device never matters for results (every
+    mesh size serves bit-identically, docs/design.md §15); only the
+    shrink itself does. Returns ``None`` when no device would survive
+    (or nothing would shrink — a named loss set disjoint from the
+    mesh), so callers shed classified instead of rebuilding in place.
+    """
+    devs = list(mesh.devices.flat)
+    lost = frozenset(int(i) for i in lost_ids)
+    if lost:
+        keep = [d for d in devs if int(d.id) not in lost]
+        if len(keep) == len(devs):
+            return None
+    else:
+        keep = devs[:-1]
+    if not keep:
+        return None
+    shape = (len(keep),) + (1,) * (len(mesh.axis_names) - 1)
+    return Mesh(np.asarray(keep).reshape(shape), tuple(mesh.axis_names))
+
+
 def shard_along(mesh: Mesh, tree, axis: str = "data", dim: int = 0):
     """Shard every leaf's ``dim`` dimension along a mesh axis."""
 

@@ -89,6 +89,10 @@ MESSAGES = {
         "ABORTED: The TPU worker was preempted by a maintenance event "
         "(injected)"
     ),
+    taxonomy.DEVICE_LOST: (
+        "UNAVAILABLE: TPU device lost: chip unreachable on the ICI "
+        "fabric (injected)"
+    ),
 }
 
 

@@ -56,6 +56,9 @@ ARTIFACTS_PUBLISH = "artifacts.publish"
 SERVE_DISPATCH = "serve.dispatch"
 SERVE_CACHE_PUBLISH = "serve.cache_publish"
 
+# -- device-loss recovery ----------------------------------------------
+MESH_REBUILD = "mesh.rebuild"
+
 # -- streaming updates -------------------------------------------------
 STREAM_UPDATE = "stream.update"
 STREAM_SWAP = "stream.swap"
@@ -80,6 +83,7 @@ ALL_SITES = frozenset({
     ARTIFACTS_PUBLISH,
     SERVE_DISPATCH,
     SERVE_CACHE_PUBLISH,
+    MESH_REBUILD,
     STREAM_UPDATE,
     STREAM_SWAP,
     CHAOS_SCENARIO,

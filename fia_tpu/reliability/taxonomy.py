@@ -42,6 +42,15 @@ Kinds (the ``FaultKind`` constants):
 - ``DEADLINE`` — a :class:`~fia_tpu.reliability.policy.Deadline`
   expired. Not an error in the work itself: journaled callers stop
   cleanly and resume later.
+- ``DEVICE_LOST`` — a device in the serving mesh is gone (chip
+  unreachable on the ICI fabric, unhealthy device state, a revoked
+  slice member). Unlike ``WORKER`` the surviving devices are fine:
+  recovery is a *mesh shrink* — rebuild the mesh over survivors,
+  re-place resident state, re-dispatch (``InfluenceService``
+  device-loss recovery, docs/reliability.md "Degraded modes") — not a
+  same-topology state rebuild. Carries no size evidence and is not
+  blindly retriable (the dead device stays dead), so it belongs to
+  neither ``TRANSIENT`` nor ``SIZE_EVIDENCE``.
 
 ``classify`` returns ``None`` for anything unrecognised — callers must
 re-raise those; an unknown failure retried blindly is how wrong answers
@@ -63,6 +72,7 @@ class FaultKind:
     PREEMPTION = "preemption"
     NAN = "nan"
     DEADLINE = "deadline"
+    DEVICE_LOST = "device_lost"
 
 
 OOM = FaultKind.OOM
@@ -72,6 +82,7 @@ WORKER = FaultKind.WORKER
 PREEMPTION = FaultKind.PREEMPTION
 NAN = FaultKind.NAN
 DEADLINE = FaultKind.DEADLINE
+DEVICE_LOST = FaultKind.DEVICE_LOST
 
 # Kinds whose recovery destroys no information: the same dispatch may
 # legitimately be retried (after a state rebuild for WORKER/PREEMPTION).
@@ -90,6 +101,17 @@ class NanPayload(FloatingPointError):
     (classified as ``NAN``)."""
 
 
+class DeviceLost(RuntimeError):
+    """A mesh device is gone (classified as ``DEVICE_LOST``).
+
+    Raised by our own code when it can *prove* the loss — service
+    construction finding a configured mesh referencing dead device ids,
+    a rebuild discovering a shrunken device set. Backend-raised losses
+    arrive as generic RuntimeErrors and classify via the message
+    signatures below instead.
+    """
+
+
 def classify(e: BaseException) -> str | None:
     """Classify a failure for the retry/degradation layers.
 
@@ -103,11 +125,27 @@ def classify(e: BaseException) -> str | None:
         return DEADLINE
     if isinstance(e, NanPayload):
         return NAN
+    if isinstance(e, DeviceLost):
+        return DEVICE_LOST
     if isinstance(e, MemoryError):
         return HOST_OOM
     s = str(e)
     if "RESOURCE_EXHAUSTED" in s or "out of memory" in s.lower():
         return OOM
+    low = s.lower()
+    if (
+        "device lost" in low
+        or "lost device" in low
+        # a device reported unhealthy (not the whole worker process —
+        # those match the worker signatures below): the surviving mesh
+        # members still answer, so recovery is a mesh shrink
+        or ("device" in low and "unhealthy state" in low)
+    ):
+        # checked before the preemption/worker signatures: loss
+        # messages often co-mention the worker, and device loss must
+        # NOT trigger a same-topology rebuild-and-retry — the dead
+        # device would just kill the retry too
+        return DEVICE_LOST
     if "preempt" in s.lower() or "maintenance event" in s.lower():
         # TPU preemption surfaces as ABORTED/UNAVAILABLE "... worker
         # preempted" (or a maintenance-event notice); checked before the

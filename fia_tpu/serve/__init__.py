@@ -15,18 +15,29 @@ Layers (each its own module, composable without the service):
   the verified on-disk tier beneath it (reliability/artifacts.py).
 - :mod:`fia_tpu.serve.scheduler` — the micro-batching planner.
 - :mod:`fia_tpu.serve.admission` — queue-depth/deadline admission.
+- :mod:`fia_tpu.serve.health`    — the brownout ladder
+  (``full → bank_preferred → cache_only``) and its hysteresis.
 - :mod:`fia_tpu.serve.metrics`   — per-request JSONL events + rollups.
 - :mod:`fia_tpu.serve.service`   — :class:`InfluenceService`, the event
-  loop tying the above to an :class:`InfluenceEngine`.
+  loop tying the above to an :class:`InfluenceEngine`, including
+  device-loss mesh-shrink recovery (docs/design.md §18).
 """
 
 from fia_tpu.serve.admission import (  # noqa: F401
     REASON_DEADLINE,
+    REASON_DEGRADED,
     REASON_INVALID,
     REASON_OVERLOAD,
     AdmissionController,
 )
 from fia_tpu.serve.cache import CacheStats, HotBlockCache  # noqa: F401
+from fia_tpu.serve.health import (  # noqa: F401
+    MODE_BANK_PREFERRED,
+    MODE_CACHE_ONLY,
+    MODE_FULL,
+    HealthConfig,
+    HealthController,
+)
 from fia_tpu.serve.metrics import ServeMetrics  # noqa: F401
 from fia_tpu.serve.request import Request, Response  # noqa: F401
 from fia_tpu.serve.scheduler import MicroBatcher  # noqa: F401

@@ -22,10 +22,14 @@ from fia_tpu.serve.request import Request, Ticket
 
 # Rejection reasons. DEADLINE is the taxonomy kind (a request whose
 # budget expired is the same failure class as a Deadline-guarded
-# workload stopping); the others are admission-specific.
+# workload stopping); the others are admission-specific. DEGRADED is
+# stamped by the service, not this controller: a brownout mode
+# (serve/health.py) shedding miss-path work — the request was valid and
+# the queue had room, but the active mode serves only bank/cache hits.
 REASON_DEADLINE = taxonomy.DEADLINE
 REASON_OVERLOAD = "overload"
 REASON_INVALID = "invalid"
+REASON_DEGRADED = "degraded"
 
 
 class AdmissionController:

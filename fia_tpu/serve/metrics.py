@@ -23,7 +23,15 @@ record carries ``t``, a wall-clock epoch-seconds stamp):
      "rejected": {reason: n}, "tiers": {tier: n}, "hot_hit_rate": f,
      "queue_wait_ms": {"p50": f, "p95": f, "max": f},
      "solve_ms": {"p50": f, "p95": f, "max": f},
-     "batches": n, "mean_batch_size": f, "cache": {...}}
+     "batches": n, "mean_batch_size": f, "cache": {...},
+     "modes": {mode: n}, "mode_transitions": n,
+     "device_loss_recoveries": n}
+
+``serve.mode`` — one line per brownout-ladder transition
+(docs/reliability.md "Degraded modes")::
+
+    {"event": "serve.mode", "from": mode, "to": mode, "tick": n,
+     "error_rate": f, "queue_frac": f}
 
 ``scripts/latency_report.py`` renders a human report from these lines;
 the schema is the stable surface operators build dashboards on.
@@ -46,7 +54,7 @@ from fia_tpu.utils.logging import EventLog
 SCHEMA = {
     "serve.request": (
         "id", "user", "item", "status", "reason", "tier",
-        "queue_wait_ms", "solve_ms", "batch_id", "batch_size",
+        "queue_wait_ms", "solve_ms", "batch_id", "batch_size", "mode",
     ),
     "serve.batch": (
         "batch_id", "size", "total_rows", "solve_ms", "status",
@@ -54,8 +62,11 @@ SCHEMA = {
     "serve.rollup": (
         "requests", "ok", "rejected", "tiers", "hot_hit_rate",
         "queue_wait_ms", "solve_ms", "batches", "mean_batch_size",
-        "cache",
+        "cache", "modes", "mode_transitions", "device_loss_recoveries",
     ),
+    # one line per brownout-ladder transition (serve/health.py): the
+    # windowed signal values that drove the step, for post-mortems
+    "serve.mode": ("from", "to", "tick", "error_rate", "queue_frac"),
     # streaming updates (docs/design.md §17): one line per
     # apply_updates attempt, and one per epoch-fenced serving swap with
     # its surgical-invalidation accounting
@@ -97,7 +108,10 @@ class ServeMetrics:
         self.by_status: dict[str, int] = {}
         self.by_reason: dict[str, int] = {}
         self.by_tier: dict[str, int] = {}
+        self.by_mode: dict[str, int] = {}
         self.batch_sizes: list[int] = []
+        self.mode_transitions = 0
+        self.device_loss_recoveries = 0
 
     def record_request(self, resp: Response) -> None:
         self.by_status[resp.status] = self.by_status.get(resp.status, 0) + 1
@@ -109,6 +123,8 @@ class ServeMetrics:
             self.by_tier[resp.cache_tier] = (
                 self.by_tier.get(resp.cache_tier, 0) + 1
             )
+        if resp.mode:
+            self.by_mode[resp.mode] = self.by_mode.get(resp.mode, 0) + 1
         if resp.ok:
             self.queue_wait_ms.append(resp.queue_wait_s * 1e3)
             self.solve_ms.append(resp.solve_s * 1e3)
@@ -122,6 +138,16 @@ class ServeMetrics:
             total_rows=int(total_rows),
             solve_ms=round(solve_s * 1e3, 3), status=status,
         )
+
+    def record_mode(self, **fields) -> None:
+        """One ``serve.mode`` line (a brownout-ladder transition)."""
+        self.mode_transitions += 1
+        self.log.log("serve.mode", **fields)
+
+    def record_device_loss_recovery(self) -> None:
+        """Count one completed mesh-shrink recovery (no event line of
+        its own — the ``mesh.rebuild`` site and the rollup carry it)."""
+        self.device_loss_recoveries += 1
 
     def record_update(self, **fields) -> None:
         """One ``stream.update`` line (an apply_updates attempt)."""
@@ -151,6 +177,9 @@ class ServeMetrics:
             "mean_batch_size": round(
                 float(np.mean(self.batch_sizes)), 2
             ) if self.batch_sizes else 0.0,
+            "modes": dict(self.by_mode),
+            "mode_transitions": self.mode_transitions,
+            "device_loss_recoveries": self.device_loss_recoveries,
         }
         if cache_stats is not None:
             out["cache"] = dict(cache_stats)

@@ -81,6 +81,10 @@ class Response:
     solve_s: float = 0.0
     batch_id: int | None = None
     batch_size: int | None = None
+    # serving mode active when this response was produced ("full" /
+    # "bank_preferred" / "cache_only", serve/health.py) — every answer
+    # AND every rejection says what regime produced it
+    mode: str | None = None
     extra: dict = field(default_factory=dict)
 
     @property
@@ -100,6 +104,7 @@ class Response:
             "solve_ms": round(self.solve_s * 1e3, 3),
             "batch_id": self.batch_id,
             "batch_size": self.batch_size,
+            "mode": self.mode,
         }
         if include_payload and self.scores is not None:
             out["scores"] = np.asarray(self.scores).tolist()

@@ -38,9 +38,18 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from fia_tpu.reliability import inject, sites, taxonomy
-from fia_tpu.serve.admission import REASON_DEADLINE, AdmissionController
+from fia_tpu.serve.admission import (
+    REASON_DEADLINE,
+    REASON_DEGRADED,
+    AdmissionController,
+)
 from fia_tpu.serve import cache as scache
 from fia_tpu.serve.cache import BlockEntry, HotBlockCache
+from fia_tpu.serve.health import (
+    MODE_FULL,
+    HealthConfig,
+    HealthController,
+)
 from fia_tpu.serve.metrics import ServeMetrics
 from fia_tpu.serve.request import (
     STATUS_REJECTED,
@@ -91,6 +100,8 @@ class ServeConfig:
     # False skips the preload — the engine still loads lazily on its
     # first precomputed dispatch.
     factor_bank: bool = True
+    # Brownout-ladder thresholds (serve/health.py); None = defaults.
+    health: HealthConfig | None = None
 
 
 def _resolve_mesh(mesh):
@@ -144,8 +155,23 @@ class InfluenceService:
         eng = self._peek_engine()
         self.mesh = _resolve_mesh(self.config.mesh)
         if self.mesh is not None:
-            from fia_tpu.parallel.mesh import mesh_fingerprint
+            from fia_tpu.parallel.mesh import (
+                lost_device_ids,
+                mesh_fingerprint,
+            )
 
+            # liveness first: a configured mesh referencing dead device
+            # ids must fail construction with a CLASSIFIED error (the
+            # operator restarted onto a shrunk slice), not surface as a
+            # backend RuntimeError at the first dispatch
+            dead = lost_device_ids(self.mesh)
+            if dead:
+                raise taxonomy.DeviceLost(
+                    f"ServeConfig.mesh references device id(s) "
+                    f"{list(dead)} the backend cannot see; rebuild the "
+                    "mesh over live devices (parallel.mesh.make_mesh / "
+                    "surviving_mesh) before constructing the service"
+                )
             if mesh_fingerprint(getattr(eng, "mesh", None)) != \
                     mesh_fingerprint(self.mesh):
                 raise ValueError(
@@ -154,6 +180,7 @@ class InfluenceService:
                     "(InfluenceEngine(mesh=...) / cli mesh_for) or use "
                     "from_model, which builds its engines over it"
                 )
+        self.health = HealthController(self.config.health)
         self.admission = AdmissionController(
             max_queue=self.config.max_queue,
             default_deadline_s=self.config.default_deadline_s,
@@ -174,6 +201,9 @@ class InfluenceService:
         # dispatch log: (batch_id, (T, 2) points) per device dispatch —
         # the byte-identity tests and capacity post-mortems read this
         self.dispatch_log: list[tuple[int, np.ndarray]] = []
+        # per-drain health signals (classified failures / dispatches)
+        self._drain_errors = 0
+        self._drain_dispatches = 0
 
     # -- wiring ------------------------------------------------------------
     @classmethod
@@ -299,6 +329,7 @@ class InfluenceService:
             resp = Response(
                 id=req.id, user=req.user, item=req.item,
                 status=STATUS_REJECTED, reason=reason,
+                mode=self.health.mode,
             )
             self.metrics.record_request(resp)
             return resp
@@ -326,8 +357,14 @@ class InfluenceService:
         """
         if not self._queue:
             return []
+        depth = len(self._queue)  # health signal: occupancy at drain start
         work, self._queue = self._queue, []
         now = self.clock()
+        # the mode is FIXED for the whole drain (self.health only moves
+        # in the observe() below) — within-drain decisions stay a pure
+        # function of the signal history, never of this drain's own luck
+        self._drain_errors = 0
+        self._drain_dispatches = 0
 
         responses: dict[int, Response] = {}  # queue position -> response
         by_epoch: dict[int, list[tuple[int, Ticket]]] = {}
@@ -348,6 +385,13 @@ class InfluenceService:
         out = [responses[pos] for pos in sorted(responses)]
         for r in out:
             self.metrics.record_request(r)
+        n0 = len(self.health.transitions)
+        self.health.observe(
+            errors=self._drain_errors, dispatches=self._drain_dispatches,
+            queue_depth=depth, queue_cap=self.admission.max_queue,
+        )
+        for tr in self.health.transitions[n0:]:
+            self.metrics.record_mode(**tr)
         return out
 
     def _resolve_group(self, eng, fp, live, responses) -> None:
@@ -368,8 +412,35 @@ class InfluenceService:
                 continue
             misses.setdefault(key, []).append((pos, t))
 
+        if misses and self.health.mode != MODE_FULL:
+            misses = self._shed_degraded(eng, misses, responses)
         if misses:
             self._dispatch_misses(eng, fp, misses, responses)
+
+    def _shed_degraded(self, eng, misses, responses) -> dict:
+        """Brownout: keep only the misses the active mode may serve.
+
+        ``bank_preferred`` keeps misses the precomputed factor bank
+        answers in O(1) (a triangular solve against resident factors —
+        docs/design.md §14, unchanged bytes vs full mode); every other
+        miss — and every miss in ``cache_only`` — is rejected with the
+        ``degraded`` reason. Hits never reach here: degraded modes shed
+        only miss-path work.
+        """
+        bank_ok = (
+            self.health.allows_bank()
+            and eng.solver == "precomputed"
+            and eng.ensure_factor_bank() > 0
+        )
+        keep: dict[tuple, list] = {}
+        now = self.clock()
+        for key, waiting in misses.items():
+            if bank_ok and eng.bank_contains(key[2], key[3]):
+                keep[key] = waiting
+                continue
+            for pos, t in waiting:
+                responses[pos] = self._reject(t, REASON_DEGRADED, now)
+        return keep
 
     def _overlap_eligible(self, eng) -> bool:
         """Windowed dispatch applies only where query_batch would run
@@ -420,14 +491,45 @@ class InfluenceService:
                     kind = taxonomy.classify(e)
                     if kind is None:
                         raise
+                    if kind == taxonomy.DEVICE_LOST:
+                        # a lost device poisons the in-flight handles
+                        # too: shrink the mesh, then re-dispatch this
+                        # batch, the in-flight ones, and the remainder
+                        # through the guarded path on the survivors —
+                        # nothing sheds, the stream completes
+                        # bit-identically (docs/design.md §18). Only if
+                        # no shrink is possible does this batch shed.
+                        if self._recover_device_loss(eng, [
+                            points[b] for (b, _, _, _) in inflight
+                        ] + [bpts] + [points[b] for b in plan[bi:]]):
+                            retry = [(b, b_bid)
+                                     for (b, b_bid, _, _) in inflight]
+                            retry += [(batch, bid)]
+                            retry += [(b, None) for b in plan[bi:]]
+                            inflight.clear()
+                            for b, b_bid in retry:
+                                self._dispatch_one(eng, fp, misses,
+                                                   responses, keys,
+                                                   counts, points, b,
+                                                   bid=b_bid)
+                            return
                     self._shed_batch(misses, responses, keys, counts,
                                      batch, bid, kind, t0)
                     continue
                 try:
                     h = eng._dispatch_flat(bpts, None)
                 except Exception as e:
-                    if taxonomy.classify(e) is None:
+                    kind = taxonomy.classify(e)
+                    if kind is None:
                         raise
+                    if kind == taxonomy.DEVICE_LOST:
+                        # best-effort shrink before rerouting: on
+                        # success the guarded path below re-dispatches
+                        # everything on the surviving mesh; on failure
+                        # it sheds classified, batch by batch
+                        self._recover_device_loss(eng, [
+                            points[b] for (b, _, _, _) in inflight
+                        ] + [bpts] + [points[b] for b in plan[bi:]])
                     # A real dispatch-time device fault poisons the
                     # in-flight handles too. Nothing sheds here: reroute
                     # this batch, the in-flight ones, and the remainder
@@ -459,15 +561,31 @@ class InfluenceService:
                 kind = taxonomy.classify(e)
                 if kind is None:
                     raise
-                self._shed_batch(misses, responses, keys, counts, batch,
-                                 bid, kind, t0)
                 # A classified finalize fault (worker crash, preemption)
                 # killed every in-flight buffer with it. Shed ONLY the
                 # faulted batch; drop the dead handles and re-dispatch
                 # their batches — plus the unplanned remainder — through
                 # the guarded sequential path, whose engine-side ladder
                 # (reset → retry → halve → CPU rung) owns the recovery.
-                retry = [(b, b_bid) for (b, b_bid, _, _) in inflight]
+                # DEVICE_LOST differs: the ladder cannot fix a dead
+                # device, so shrink the mesh first — and on a
+                # successful shrink the faulted batch re-dispatches too
+                # instead of shedding (its inputs are host-side; only
+                # the dead device's output buffers were lost).
+                recovered = (
+                    kind == taxonomy.DEVICE_LOST
+                    and self._recover_device_loss(eng, [
+                        points[batch]
+                    ] + [points[b] for (b, _, _, _) in inflight]
+                        + [points[b] for b in plan[bi:]])
+                )
+                retry = []
+                if recovered:
+                    retry += [(batch, bid)]
+                else:
+                    self._shed_batch(misses, responses, keys, counts,
+                                     batch, bid, kind, t0)
+                retry += [(b, b_bid) for (b, b_bid, _, _) in inflight]
                 retry += [(b, None) for b in plan[bi:]]
                 inflight.clear()
                 for b, b_bid in retry:
@@ -495,6 +613,16 @@ class InfluenceService:
             kind = taxonomy.classify(e)
             if kind is None:
                 raise
+            if kind == taxonomy.DEVICE_LOST and self._recover_device_loss(
+                eng, [points[batch]]
+            ):
+                # shrink succeeded: this very batch re-dispatches on the
+                # surviving mesh (recursion is bounded — every recovery
+                # drops a device, and with none left to drop the shrink
+                # fails and the batch sheds classified below)
+                self._dispatch_one(eng, fp, misses, responses, keys,
+                                   counts, points, batch, bid=bid)
+                return
             self._shed_batch(misses, responses, keys, counts, batch, bid,
                              kind, t0)
             return
@@ -503,6 +631,8 @@ class InfluenceService:
 
     def _shed_batch(self, misses, responses, keys, counts, batch, bid,
                     kind, t0) -> None:
+        self._drain_errors += 1
+        self._drain_dispatches += 1
         dt = self.clock() - t0
         self.metrics.record_batch(
             bid, len(batch), int(counts[batch].sum()), dt, status=kind
@@ -516,6 +646,7 @@ class InfluenceService:
 
     def _bank_batch(self, eng, fp, misses, responses, keys, counts, batch,
                     bid, res, t0) -> None:
+        self._drain_dispatches += 1
         dt = self.clock() - t0
         self.metrics.record_batch(
             bid, len(batch), int(counts[batch].sum()), dt
@@ -567,6 +698,7 @@ class InfluenceService:
             test_grad=entry.test_grad, cache_tier=tier,
             queue_wait_s=max(now - t.t_arrival, 0.0), solve_s=solve_s,
             batch_id=batch_id, batch_size=batch_size,
+            mode=self.health.mode,
         )
 
     def _reject(self, t: Ticket, reason: str, now: float, batch_id=None,
@@ -576,7 +708,50 @@ class InfluenceService:
             status=STATUS_REJECTED, reason=reason,
             queue_wait_s=max(now - t.t_arrival, 0.0),
             batch_id=batch_id, batch_size=batch_size,
+            mode=self.health.mode,
         )
+
+    # -- device-loss recovery (docs/design.md §18) -------------------------
+    def _recover_device_loss(self, eng, pending_points) -> bool:
+        """Shrink the serving mesh over the surviving devices.
+
+        Called when a dispatch failure classified ``device_lost``: asks
+        the backend which mesh devices are still visible
+        (:func:`~fia_tpu.parallel.mesh.lost_device_ids`; an injected
+        loss names none, so the deterministic last-device drop
+        applies), re-homes the engine on the survivors
+        (:meth:`~fia_tpu.influence.engine.InfluenceEngine.rebuild_mesh`)
+        and AOT re-arms the still-pending dispatch geometries so
+        steady state stays zero-compile on the new topology. Results
+        are unchanged by construction — every mesh size runs the exact
+        single-device program per shard (docs/design.md §15).
+
+        Returns False — caller sheds classified — when there is no mesh
+        to shrink (single-device engine), no survivor to shrink to, or
+        the rebuild itself failed with a classified fault.
+        """
+        from fia_tpu.parallel import mesh as pmesh
+
+        cur = getattr(eng, "mesh", None)
+        if cur is None:
+            return False
+        new = pmesh.surviving_mesh(cur, pmesh.lost_device_ids(cur))
+        if new is None:
+            return False
+        try:
+            eng.rebuild_mesh(new)
+            if (eng.impl in ("auto", "flat") and eng._flat_eligible()
+                    and not eng._wide_block_cap() and not eng._multihost):
+                geoms = {tuple(eng.flat_geometry(np.asarray(p)))
+                         for p in pending_points if len(p)}
+                eng.precompile_flat(sorted(geoms))
+        except Exception as e:
+            if taxonomy.classify(e) is None:
+                raise
+            return False
+        self.mesh = new
+        self.metrics.record_device_loss_recovery()
+        return True
 
     def _disk_dir(self, eng) -> str | None:
         if not self.config.disk_cache or not eng.cache_dir:

@@ -1,0 +1,196 @@
+#!/usr/bin/env bash
+# Degraded-mode smoke: the two r12 survival paths end-to-end on CPU
+# (docs/design.md §18, docs/reliability.md "Degraded modes"):
+#   - device loss: a `device_lost` fault injected at serve.dispatch on
+#     a 4-device virtual mesh must shrink to 3 devices, recover, and
+#     serve the whole stream bit-identical to a fault-free
+#     single-device reference — zero sheds, zero unclassified errors
+#   - brownout: forced dispatch failures must step the health ladder
+#     down to `bank_preferred`, where factor-bank hits keep serving
+#     byte-identical answers, misses shed with reason `degraded`, and
+#     calm traffic steps the mode back to `full` with no flapping
+#
+#   bash scripts/degraded_smoke.sh        (or: make degraded-smoke)
+#
+# Budget: <60s on CPU — tiny MF workloads, 8 virtual devices, virtual
+# clock (no wall sleeps), a throwaway tmpdir for the factor bank.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+DIR=$(mktemp -d /tmp/fia_degraded_smoke.XXXXXX)
+trap 'rm -rf "$DIR"' EXIT
+
+# the mesh leg needs multiple devices: 8 virtual CPU devices, same
+# trick as tests/conftest.py, unless the caller already forced a count
+if [[ "${XLA_FLAGS:-}" != *xla_force_host_platform_device_count* ]]; then
+  export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
+fi
+
+JAX_PLATFORMS=cpu timeout -k 10 300 python - "$DIR" <<'EOF'
+import sys
+
+import jax
+import numpy as np
+
+from fia_tpu.data.dataset import RatingDataset
+from fia_tpu.influence import factor as fbank
+from fia_tpu.influence.engine import InfluenceEngine
+from fia_tpu.models import MF
+from fia_tpu.parallel.mesh import make_mesh
+from fia_tpu.reliability import inject, taxonomy
+from fia_tpu.reliability import policy as rpolicy
+from fia_tpu.serve import (
+    HealthConfig,
+    InfluenceService,
+    Request,
+    ServeConfig,
+)
+
+WORKDIR = sys.argv[1]
+U, I, K = 30, 20, 4
+WD, DAMP = 1e-2, 1e-3
+
+rng = np.random.default_rng(0)
+x = np.stack([rng.integers(0, U, 400), rng.integers(0, I, 400)],
+             axis=1).astype(np.int32)
+y = rng.integers(1, 6, 400).astype(np.float32)
+model = MF(U, I, K, WD)
+params = model.init_params(jax.random.PRNGKey(0))
+train = RatingDataset(x, y)
+
+assert jax.device_count() >= 4, (
+    f"need >=4 virtual devices, got {jax.device_count()} "
+    "(XLA_FLAGS device-count guard failed?)"
+)
+
+# ---- leg 1: device loss on a 4-device mesh -------------------------
+flat = rng.choice(U * I, size=8, replace=False)
+pairs = [(int(k // I), int(k % I)) for k in flat]
+reqs = lambda: [Request(u, i, id=f"q{n}")
+                for n, (u, i) in enumerate(pairs)]
+
+ref_svc = InfluenceService(
+    engine=InfluenceEngine(model, params, train, damping=DAMP,
+                           model_name="degraded-smoke"),
+    config=ServeConfig(max_batch=3, max_queue=64, disk_cache=False),
+    clock=rpolicy.VirtualClock(),
+)
+ref = {r.id: np.asarray(r.scores).copy()
+       for r in ref_svc.run(reqs(), drain_every=8)}
+assert len(ref) == 8, f"reference run rejected requests: {len(ref)}/8"
+
+mesh = make_mesh(4)
+svc = InfluenceService(
+    engine=InfluenceEngine(model, params, train, damping=DAMP,
+                           model_name="degraded-smoke", mesh=mesh),
+    config=ServeConfig(max_batch=3, max_queue=64, disk_cache=False,
+                       mesh=mesh),
+    clock=rpolicy.VirtualClock(),
+)
+with inject.active(
+    inject.Fault("serve.dispatch", at=1, kind=taxonomy.DEVICE_LOST),
+    strict=True, validate=True,
+):
+    responses = svc.run(reqs(), drain_every=8)
+
+stale = unclassified = 0
+for r in responses:
+    if not r.ok:
+        unclassified += 0 if r.reason else 1
+    elif not np.array_equal(np.asarray(r.scores), ref[r.id]):
+        stale += 1
+ok = sum(1 for r in responses if r.ok)
+roll = svc.rollup()
+ndev = int(svc.mesh.devices.size)
+assert unclassified == 0, f"{unclassified} unclassified rejections"
+assert ok == 8, f"device loss shed requests: {ok}/8 served"
+assert stale == 0, f"{stale} responses diverge from the fault-free ref"
+assert roll["device_loss_recoveries"] >= 1, roll
+assert ndev == 3, f"mesh did not shrink 4 -> 3 (now {ndev})"
+print(f"device-loss leg ok: {ok}/8 served bit-identical on a "
+      f"{ndev}-device mesh after {roll['device_loss_recoveries']} "
+      "recovery")
+
+# ---- leg 2: one brownout episode -----------------------------------
+eng = InfluenceEngine(model, params, train, damping=DAMP,
+                      solver="precomputed", cache_dir=WORKDIR,
+                      model_name="degraded-smoke", lissa_depth=30)
+hot = fbank.select_hot_pairs(eng.index, max_entries=16,
+                             top_users=6, top_items=6)
+bank = fbank.build_bank(eng, hot)
+fp = fbank.bank_fingerprint("degraded-smoke", model.block_size, DAMP,
+                            *eng._train_host)
+fbank.publish_bank(bank, fbank.default_bank_path(WORKDIR,
+                                                 "degraded-smoke"), fp)
+assert eng.ensure_factor_bank() == len(bank) >= 6, len(bank)
+banked = [(int(u), int(i)) for u, i in hot]
+misses = [p for p in pairs if p not in set(banked)][:3]
+assert len(misses) == 3
+
+bank_ref = {
+    p: np.asarray(eng.query_batch(
+        np.asarray([p], np.int64)).scores_of(0)).copy()
+    for p in banked[:6]
+}
+
+# err_cache_only out of reach (2.0): this episode exercises the
+# bank_preferred rung, not the cache_only floor
+svc = InfluenceService(
+    engine=eng,
+    config=ServeConfig(
+        max_batch=4, max_queue=64, disk_cache=False,
+        health=HealthConfig(window=4, err_degrade=0.5,
+                            err_cache_only=2.0, err_recover=0.25,
+                            min_evidence=2, queue_hold=3, hold=2),
+    ),
+    clock=rpolicy.VirtualClock(),
+)
+
+def wave(svc, reqs):
+    rejected = [r for r in map(svc.submit, reqs) if r is not None]
+    return rejected + svc.drain()
+
+# pressure: two drains of miss dispatches, every one failing -> the
+# windowed error rate hits 1.0 on trusted evidence
+with inject.active(
+    inject.Fault("serve.dispatch", at=0, kind=taxonomy.WORKER),
+    inject.Fault("serve.dispatch", at=1, kind=taxonomy.WORKER),
+    strict=True, validate=True,
+):
+    shed = (wave(svc, [Request(*misses[0], id="m0")])
+            + wave(svc, [Request(*misses[1], id="m1")]))
+assert all(not r.ok and r.reason == taxonomy.WORKER for r in shed), shed
+assert svc.health.mode == "bank_preferred", svc.health.mode
+
+# degraded serving: the banked pair answers byte-identically, the miss
+# sheds with the canonical `degraded` reason, both stamped with the mode
+got = {r.id: r for r in wave(svc, [Request(*banked[0], id="b0"),
+                                   Request(*misses[2], id="m2")])}
+b0, m2 = got["b0"], got["m2"]
+assert b0.ok and np.array_equal(np.asarray(b0.scores),
+                                bank_ref[banked[0]]), b0
+assert not m2.ok and m2.reason == "degraded", (m2.status, m2.reason)
+assert b0.mode == m2.mode == "bank_preferred", (b0.mode, m2.mode)
+
+# calm: fresh bank hits are clean dispatches; the ladder must step
+# back to full and every answer must stay byte-identical
+for n in range(1, 6):
+    (r,) = wave(svc, [Request(*banked[n], id=f"b{n}")])
+    assert r.ok and np.array_equal(np.asarray(r.scores),
+                                   bank_ref[banked[n]]), r
+    if svc.health.mode == "full":
+        break
+assert svc.health.mode == "full", svc.health.transitions
+trs = [(t["from"], t["to"]) for t in svc.health.transitions]
+assert trs == [("full", "bank_preferred"),
+               ("bank_preferred", "full")], trs
+
+roll = svc.rollup()
+assert roll["rejected"].get("degraded") == 1, roll["rejected"]
+assert roll["mode_transitions"] == 2, roll
+assert roll["modes"].get("bank_preferred", 0) >= 2, roll["modes"]
+print(f"brownout leg ok: ladder {trs[0][0]} -> {trs[0][1]} -> "
+      f"{trs[1][1]}, bank hits byte-identical, 1 miss shed degraded")
+EOF
+
+echo "degraded-smoke PASS"

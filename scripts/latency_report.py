@@ -21,11 +21,17 @@ import numpy as np
 # FIA401: a field renamed on the producer side fails `make lint`
 # instead of rendering an empty column here. Keep it a literal dict.
 CONSUMES = {
-    "serve.request": ("status", "reason", "tier",
+    "serve.request": ("status", "reason", "tier", "mode",
                       "queue_wait_ms", "solve_ms"),
     "serve.batch": ("size", "solve_ms"),
     "serve.rollup": ("cache",),
 }
+
+# The canonical rejection reasons (fia_tpu/serve/admission.py). The
+# histogram always prints all four, zeros included — operators diff
+# these lines across runs, and a row that appears only when nonzero
+# reads as "field renamed" rather than "count is zero".
+CANONICAL_REASONS = ("overload", "invalid", "deadline", "degraded")
 
 
 def pcts(vals):
@@ -71,12 +77,23 @@ def main(argv) -> int:
     print(f"requests: {len(reqs)}  ok: {len(ok)}  "
           f"rejected: {len(rejected)}")
 
-    by_reason: dict[str, int] = {}
+    by_reason: dict[str, int] = {r: 0 for r in CANONICAL_REASONS}
     for r in rejected:
         k = r.get("reason") or "<unreasoned!>"
         by_reason[k] = by_reason.get(k, 0) + 1
-    for k in sorted(by_reason):
+    for k in CANONICAL_REASONS:
         print(f"  rejected[{k}]: {by_reason[k]}")
+    for k in sorted(set(by_reason) - set(CANONICAL_REASONS)):
+        print(f"  rejected[{k}]: {by_reason[k]}")
+
+    by_mode: dict[str, int] = {}
+    for r in reqs:
+        m = r.get("mode")
+        if m:
+            by_mode[m] = by_mode.get(m, 0) + 1
+    if by_mode:
+        print("modes: " + "  ".join(
+            f"{k}={by_mode[k]}" for k in sorted(by_mode)))
 
     by_tier: dict[str, int] = {}
     for r in ok:

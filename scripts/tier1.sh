@@ -46,6 +46,13 @@ bash scripts/churn_smoke.sh || {
   echo "churn-smoke FAILED (run make churn-smoke)"
   exit 1
 }
+# Degraded smoke, FATAL: device-loss mesh-shrink recovery must stay
+# bit-identical and the brownout ladder must degrade/recover without
+# flapping (docs/design.md §18).
+bash scripts/degraded_smoke.sh || {
+  echo "degraded-smoke FAILED (run make degraded-smoke)"
+  exit 1
+}
 # Serving smoke next, NON-fatal: the pinned tier-1 verdict below stays
 # exactly the ROADMAP.md pytest command, the smoke just surfaces
 # serving regressions in the same log.

@@ -1,0 +1,533 @@
+"""Degraded-mode serving (docs/design.md §18): device-loss survival
+and the brownout ladder.
+
+- device loss is its own taxonomy kind, neither transient nor size
+  evidence — recovery is topological (shrink the mesh over survivors),
+  and the recovered stream must be BIT-identical to a fault-free run;
+- the health ladder is a pure function of the observed signal stream:
+  replaying the signals reproduces the transition log exactly, and the
+  hysteresis rules (sustained evidence down, held calm up, dead band)
+  make flapping structurally impossible;
+- degraded modes shed only miss-path work, stamped with the canonical
+  ``degraded`` reason; cache and bank hits keep serving unchanged
+  bytes.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from fia_tpu.data.dataset import RatingDataset
+from fia_tpu.influence import factor as fbank
+from fia_tpu.influence.engine import InfluenceEngine
+from fia_tpu.models import MF
+from fia_tpu.parallel import mesh as pmesh
+from fia_tpu.reliability import inject, taxonomy
+from fia_tpu.serve import (
+    MODE_BANK_PREFERRED,
+    MODE_CACHE_ONLY,
+    MODE_FULL,
+    REASON_DEGRADED,
+    HealthConfig,
+    HealthController,
+    InfluenceService,
+    Request,
+    ServeConfig,
+)
+
+U, I, K = 30, 20, 4
+WD = 1e-2
+DAMP = 1e-3
+
+needs_mesh = pytest.mark.skipif(
+    jax.device_count() < 4, reason="needs >=4 (virtual) devices"
+)
+
+
+def _setup(seed=0, n=400):
+    rng = np.random.default_rng(seed)
+    x = np.stack(
+        [rng.integers(0, U, n), rng.integers(0, I, n)], axis=1
+    ).astype(np.int32)
+    y = rng.integers(1, 6, n).astype(np.float32)
+    train = RatingDataset(x, y)
+    model = MF(U, I, K, WD)
+    params = model.init_params(jax.random.PRNGKey(seed))
+    return model, params, train
+
+
+def _engine(model, params, train, **kw):
+    kw.setdefault("damping", DAMP)
+    kw.setdefault("solver", "direct")
+    return InfluenceEngine(model, params, train, **kw)
+
+
+def _service(engine, **cfg):
+    cfg.setdefault("disk_cache", False)
+    return InfluenceService(engine=engine, config=ServeConfig(**cfg))
+
+
+def _unique_points(train, n):
+    uniq = np.unique(train.x, axis=0)
+    assert len(uniq) >= n
+    return uniq[:n].astype(np.int64)
+
+
+def _requests(pts):
+    return [Request(int(u), int(i), id=f"q{n}")
+            for n, (u, i) in enumerate(pts)]
+
+
+class TestDeviceLostTaxonomy:
+    def test_exception_type_classifies(self):
+        assert taxonomy.classify(
+            taxonomy.DeviceLost("chip 3 gone")) == taxonomy.DEVICE_LOST
+
+    @pytest.mark.parametrize("msg", [
+        "UNAVAILABLE: TPU device lost: chip unreachable on the ICI fabric",
+        "backend reports lost device during execution",
+        "device tpu:2 is in an unhealthy state",
+    ])
+    def test_message_signatures(self, msg):
+        assert taxonomy.classify(
+            RuntimeError(msg)) == taxonomy.DEVICE_LOST
+
+    def test_neither_transient_nor_size_evidence(self):
+        # a dead device stays dead: retrying at the same size is
+        # pointless and halving would shrink batches for no reason
+        assert taxonomy.DEVICE_LOST not in taxonomy.TRANSIENT
+        assert taxonomy.DEVICE_LOST not in taxonomy.SIZE_EVIDENCE
+
+
+class TestSurvivingMesh:
+    @needs_mesh
+    def test_drops_last_device_without_named_losses(self):
+        mesh = pmesh.make_mesh(4)
+        new = pmesh.surviving_mesh(mesh)
+        assert new is not None and new.devices.size == 3
+        assert ([int(d.id) for d in new.devices.flat]
+                == [int(d.id) for d in mesh.devices.flat][:-1])
+
+    @needs_mesh
+    def test_named_losses_are_dropped(self):
+        mesh = pmesh.make_mesh(4)
+        ids = [int(d.id) for d in mesh.devices.flat]
+        new = pmesh.surviving_mesh(mesh, lost_ids=ids[1:3])
+        assert new is not None
+        assert [int(d.id) for d in new.devices.flat] == [ids[0], ids[3]]
+        assert tuple(new.axis_names) == tuple(mesh.axis_names)
+
+    @needs_mesh
+    def test_disjoint_losses_mean_no_shrink(self):
+        # named ids not in the mesh: nothing to shrink — the caller
+        # must not rebuild onto an identical topology and retry
+        mesh = pmesh.make_mesh(2)
+        assert pmesh.surviving_mesh(mesh, lost_ids=[10 ** 9]) is None
+
+    def test_nothing_survives(self):
+        mesh = pmesh.make_mesh(1)
+        ids = [int(d.id) for d in mesh.devices.flat]
+        assert pmesh.surviving_mesh(mesh, lost_ids=ids) is None
+
+    def test_lost_device_ids_against_backend(self, monkeypatch):
+        mesh = pmesh.make_mesh(1)
+        assert pmesh.lost_device_ids(mesh) == ()
+        assert pmesh.lost_device_ids(None) == ()
+        monkeypatch.setattr(pmesh, "live_device_ids",
+                            lambda: frozenset())
+        assert pmesh.lost_device_ids(mesh) == tuple(
+            sorted(int(d.id) for d in mesh.devices.flat))
+
+
+@needs_mesh
+class TestMeshShrinkRecovery:
+    def _reference(self, model, params, train, pts):
+        svc = _service(_engine(model, params, train), max_batch=3,
+                       max_queue=64)
+        return {r.id: np.asarray(r.scores).copy()
+                for r in svc.run(_requests(pts))}
+
+    def _mesh_service(self, model, params, train, ndev):
+        mesh = pmesh.make_mesh(ndev)
+        eng = _engine(model, params, train, mesh=mesh)
+        return _service(eng, max_batch=3, max_queue=64, mesh=mesh)
+
+    def test_single_loss_recovers_bit_identical(self):
+        model, params, train = _setup()
+        pts = _unique_points(train, 8)
+        ref = self._reference(model, params, train, pts)
+
+        svc = self._mesh_service(model, params, train, 4)
+        with inject.active(
+            inject.Fault("serve.dispatch", at=1,
+                         kind=taxonomy.DEVICE_LOST),
+            strict=True, validate=True,
+        ):
+            responses = svc.run(_requests(pts))
+
+        assert all(r.ok for r in responses)
+        for r in responses:
+            assert np.array_equal(np.asarray(r.scores), ref[r.id])
+        assert int(svc.mesh.devices.size) == 3
+        assert int(svc._peek_engine().mesh.devices.size) == 3
+        assert svc.rollup()["device_loss_recoveries"] == 1
+
+    def test_consecutive_losses_keep_shrinking(self):
+        model, params, train = _setup(seed=3)
+        pts = _unique_points(train, 9)
+        ref = self._reference(model, params, train, pts)
+
+        svc = self._mesh_service(model, params, train, 4)
+        with inject.active(
+            inject.Fault("serve.dispatch", at=0,
+                         kind=taxonomy.DEVICE_LOST),
+            inject.Fault("serve.dispatch", at=2,
+                         kind=taxonomy.DEVICE_LOST),
+            strict=True, validate=True,
+        ):
+            responses = svc.run(_requests(pts))
+
+        assert all(r.ok for r in responses)
+        for r in responses:
+            assert np.array_equal(np.asarray(r.scores), ref[r.id])
+        assert int(svc.mesh.devices.size) == 2
+        assert svc.rollup()["device_loss_recoveries"] == 2
+
+    def test_zero_steady_state_compiles_after_recovery(self):
+        """Post-rebuild AOT re-arming: once the mesh has shrunk and the
+        failed work re-dispatched, further traffic at the same
+        geometries compiles nothing."""
+        model, params, train = _setup(seed=5)
+        pts = _unique_points(train, 12)
+        svc = self._mesh_service(model, params, train, 4)
+        with inject.active(
+            inject.Fault("serve.dispatch", at=1,
+                         kind=taxonomy.DEVICE_LOST),
+            strict=True, validate=True,
+        ):
+            first = svc.run(_requests(pts[:6]))
+        assert all(r.ok for r in first)
+        eng = svc._peek_engine()
+        armed = dict(eng._aot)
+        assert armed, "recovery left no AOT executables armed"
+        more = svc.run(_requests(pts[6:]))
+        assert all(r.ok for r in more)
+        assert set(eng._aot) == set(armed), (
+            "steady-state traffic after recovery compiled new "
+            "executables"
+        )
+
+    def test_meshless_loss_sheds_classified(self):
+        # no mesh to shrink: the batch sheds with the classified kind
+        # as its rejection reason and the stream keeps going
+        model, params, train = _setup(seed=1)
+        pts = _unique_points(train, 6)
+        svc = _service(_engine(model, params, train), max_batch=3,
+                       max_queue=64)
+        with inject.active(
+            inject.Fault("serve.dispatch", at=0,
+                         kind=taxonomy.DEVICE_LOST),
+            strict=True, validate=True,
+        ):
+            responses = svc.run(_requests(pts))
+        shed = [r for r in responses if not r.ok]
+        assert len(shed) == 3
+        assert all(r.reason == taxonomy.DEVICE_LOST for r in shed)
+        assert sum(1 for r in responses if r.ok) == 3
+
+    def test_rebuild_fault_fails_classified(self):
+        """A second fault during the rebuild itself must not escape
+        unclassified: recovery aborts, the batch sheds with the
+        device-loss reason, the rest of the stream still serves."""
+        model, params, train = _setup(seed=2)
+        pts = _unique_points(train, 8)
+        svc = self._mesh_service(model, params, train, 4)
+        with inject.active(
+            inject.Fault("serve.dispatch", at=1,
+                         kind=taxonomy.DEVICE_LOST),
+            inject.Fault("mesh.rebuild", at=0, kind=taxonomy.OOM),
+            strict=True, validate=True,
+        ):
+            responses = svc.run(_requests(pts))
+        shed = [r for r in responses if not r.ok]
+        assert shed, "rebuild fault should shed the failed batch"
+        assert all(taxonomy.classify(RuntimeError(r.reason)) or
+                   r.reason in (taxonomy.DEVICE_LOST, taxonomy.OOM)
+                   for r in shed)
+        assert any(r.ok for r in responses)
+
+
+class TestConstructionLiveness:
+    def test_dead_mesh_device_fails_construction(self, monkeypatch):
+        model, params, train = _setup()
+        mesh = pmesh.make_mesh(1)
+        eng = _engine(model, params, train, mesh=mesh)
+        dead_id = int(next(iter(mesh.devices.flat)).id)
+        monkeypatch.setattr(
+            pmesh, "live_device_ids",
+            lambda: frozenset(
+                int(d.id) for d in jax.devices()) - {dead_id},
+        )
+        with pytest.raises(taxonomy.DeviceLost) as ei:
+            _service(eng, mesh=mesh)
+        assert taxonomy.classify(ei.value) == taxonomy.DEVICE_LOST
+        assert str(dead_id) in str(ei.value)
+
+    def test_live_mesh_constructs(self):
+        model, params, train = _setup()
+        mesh = pmesh.make_mesh(1)
+        eng = _engine(model, params, train, mesh=mesh)
+        svc = _service(eng, mesh=mesh)
+        assert svc.health.mode == MODE_FULL
+
+
+class TestHealthController:
+    def test_config_validation(self):
+        with pytest.raises(ValueError):
+            HealthConfig(err_recover=0.5, err_degrade=0.5).validate()
+        with pytest.raises(ValueError):
+            HealthConfig(queue_recover=0.9, queue_degrade=0.9).validate()
+        with pytest.raises(ValueError):
+            HealthConfig(min_evidence=0).validate()
+        HealthConfig().validate()
+
+    def test_replay_reproduces_transition_log(self):
+        """The controller is a pure function of the signal stream: no
+        wall clock, no randomness — the same observations give the
+        same transitions, tick for tick."""
+        rng = np.random.default_rng(11)
+        stream = [
+            dict(errors=int(rng.integers(0, 3)),
+                 dispatches=int(rng.integers(0, 4)),
+                 queue_depth=int(rng.integers(0, 10)), queue_cap=8)
+            for _ in range(200)
+        ]
+        a, b = HealthController(), HealthController()
+        modes_a = [a.observe(**s) for s in stream]
+        modes_b = [b.observe(**s) for s in stream]
+        assert modes_a == modes_b
+        assert a.transitions == b.transitions
+
+    def test_error_signal_needs_evidence(self):
+        # one shed two-batch drain is 100% "error rate" on no
+        # evidence: the window must hold min_evidence dispatches first
+        hc = HealthController(HealthConfig(min_evidence=4,
+                                           err_cache_only=2.0))
+        assert hc.observe(errors=2, dispatches=2) == MODE_FULL
+        assert hc.observe(errors=2, dispatches=2) == MODE_BANK_PREFERRED
+
+    def test_queue_signal_needs_consecutive_saturation(self):
+        # a full queue at one drain is maximal coalescing working as
+        # intended; only a queue pinned full across drains is pressure
+        hc = HealthController(HealthConfig(queue_hold=3))
+        assert hc.observe(queue_depth=8, queue_cap=8) == MODE_FULL
+        assert hc.observe(queue_depth=8, queue_cap=8) == MODE_FULL
+        assert hc.observe(queue_depth=8, queue_cap=8) == \
+            MODE_BANK_PREFERRED
+
+    def test_queue_saturation_resets_on_calm_sample(self):
+        hc = HealthController(HealthConfig(queue_hold=2))
+        hc.observe(queue_depth=8, queue_cap=8)
+        hc.observe(queue_depth=0, queue_cap=8)  # resets the streak
+        hc.observe(queue_depth=8, queue_cap=8)
+        assert hc.mode == MODE_FULL
+
+    def test_queue_alone_never_forces_cache_only(self):
+        hc = HealthController(HealthConfig(queue_hold=1))
+        for _ in range(20):
+            hc.observe(queue_depth=8, queue_cap=8)
+        assert hc.mode == MODE_BANK_PREFERRED
+
+    def test_error_rate_can_jump_to_cache_only(self):
+        hc = HealthController(HealthConfig(min_evidence=4))
+        hc.observe(errors=4, dispatches=4)
+        assert hc.mode == MODE_CACHE_ONLY
+        assert [t["to"] for t in hc.transitions] == [MODE_CACHE_ONLY]
+
+    def test_recovery_is_held_and_one_rung_at_a_time(self):
+        hc = HealthController(HealthConfig(min_evidence=2, hold=2,
+                                           window=4))
+        hc.observe(errors=4, dispatches=4)
+        assert hc.mode == MODE_CACHE_ONLY
+        # calm samples: two per rung, never skipping a rung
+        seen = [hc.observe(dispatches=1) for _ in range(8)]
+        assert MODE_BANK_PREFERRED in seen
+        assert seen[-1] == MODE_FULL
+        tos = [t["to"] for t in hc.transitions]
+        assert tos == [MODE_CACHE_ONLY, MODE_BANK_PREFERRED, MODE_FULL]
+
+    def test_dead_band_prevents_flapping(self):
+        """A signal hovering between recover and degrade thresholds
+        moves the mode exactly once, never back and forth."""
+        cfg = HealthConfig(window=4, min_evidence=2, err_degrade=0.5,
+                           err_cache_only=2.0, err_recover=0.25, hold=2)
+        hc = HealthController(cfg)
+        hc.observe(errors=2, dispatches=2)
+        assert hc.mode == MODE_BANK_PREFERRED
+        # hover at ~0.4 error rate: inside the dead band — no recovery
+        # (calm resets), no further degrade
+        for _ in range(12):
+            hc.observe(errors=1, dispatches=3)
+        assert hc.mode == MODE_BANK_PREFERRED
+        assert len(hc.transitions) == 1
+
+    def test_interrupted_calm_restarts_the_hold(self):
+        cfg = HealthConfig(window=2, min_evidence=2, hold=3,
+                           err_cache_only=2.0)
+        hc = HealthController(cfg)
+        hc.observe(errors=2, dispatches=2)
+        assert hc.mode == MODE_BANK_PREFERRED
+        hc.observe(dispatches=1)  # error still in window: not calm
+        hc.observe(dispatches=1)  # error aged out: calm 1
+        hc.observe(dispatches=1)  # calm 2
+        # a saturated-queue sample is not calm: the hold restarts
+        hc.observe(dispatches=1, queue_depth=8, queue_cap=8)
+        hc.observe(dispatches=1)  # calm 1
+        hc.observe(dispatches=1)  # calm 2
+        assert hc.mode == MODE_BANK_PREFERRED
+        hc.observe(dispatches=1)  # calm 3
+        assert hc.mode == MODE_FULL
+
+
+class TestBrownoutServing:
+    def _bank_engine(self, model, params, train, tmp_path):
+        eng = InfluenceEngine(
+            model, params, train, damping=DAMP, solver="precomputed",
+            cache_dir=str(tmp_path), model_name="degraded-test",
+            lissa_depth=30)
+        hot = fbank.select_hot_pairs(eng.index, max_entries=16,
+                                     top_users=6, top_items=6)
+        bank = fbank.build_bank(eng, hot)
+        fp = fbank.bank_fingerprint("degraded-test", model.block_size,
+                                    DAMP, *eng._train_host)
+        fbank.publish_bank(
+            bank, fbank.default_bank_path(str(tmp_path),
+                                          "degraded-test"), fp)
+        assert eng.ensure_factor_bank() == len(bank) >= 6
+        return eng, [(int(u), int(i)) for u, i in hot]
+
+    def _degrade(self, svc, misses):
+        """Two all-shed drains: trusted 100% error rate."""
+        with inject.active(
+            inject.Fault("serve.dispatch", at=0, kind=taxonomy.WORKER),
+            inject.Fault("serve.dispatch", at=1, kind=taxonomy.WORKER),
+            strict=True, validate=True,
+        ):
+            for n, p in enumerate(misses):
+                svc.submit(Request(*p, id=f"m{n}"))
+                svc.drain()
+
+    def _health_cfg(self, **kw):
+        kw.setdefault("window", 4)
+        kw.setdefault("min_evidence", 2)
+        kw.setdefault("hold", 2)
+        # out of reach by default: these tests target bank_preferred
+        kw.setdefault("err_cache_only", 2.0)
+        return HealthConfig(**kw)
+
+    def test_bank_preferred_serves_bank_shed_misses(self, tmp_path):
+        model, params, train = _setup()
+        eng, banked = self._bank_engine(model, params, train, tmp_path)
+        misses = [tuple(p) for p in _unique_points(train, 20)
+                  if tuple(p) not in set(banked)][:3]
+        ref = np.asarray(eng.query_batch(
+            np.asarray([banked[0]], np.int64)).scores_of(0)).copy()
+
+        svc = _service(eng, max_batch=4, max_queue=64,
+                       health=self._health_cfg())
+        self._degrade(svc, misses[:2])
+        assert svc.health.mode == MODE_BANK_PREFERRED
+
+        svc.submit(Request(*banked[0], id="b0"))
+        svc.submit(Request(*misses[2], id="m2"))
+        got = {r.id: r for r in svc.drain()}
+        b0, m2 = got["b0"], got["m2"]
+        assert b0.ok and np.array_equal(np.asarray(b0.scores), ref)
+        assert not m2.ok and m2.reason == REASON_DEGRADED
+        assert b0.mode == m2.mode == MODE_BANK_PREFERRED
+
+        roll = svc.rollup()
+        assert roll["rejected"].get(REASON_DEGRADED) == 1
+        assert roll["modes"].get(MODE_BANK_PREFERRED, 0) >= 2
+
+    def test_recovers_to_full_without_flapping(self, tmp_path):
+        model, params, train = _setup()
+        eng, banked = self._bank_engine(model, params, train, tmp_path)
+        misses = [tuple(p) for p in _unique_points(train, 20)
+                  if tuple(p) not in set(banked)][:2]
+        svc = _service(eng, max_batch=4, max_queue=64,
+                       health=self._health_cfg())
+        self._degrade(svc, misses)
+        assert svc.health.mode == MODE_BANK_PREFERRED
+
+        # fresh bank hits are clean dispatches; the error window decays
+        # and the ladder steps back up exactly once
+        for n, p in enumerate(banked[:6]):
+            assert svc.submit(Request(*p, id=f"b{n}")) is None
+            (r,) = svc.drain()
+            assert r.ok
+            if svc.health.mode == MODE_FULL:
+                break
+        assert svc.health.mode == MODE_FULL
+        assert [(t["from"], t["to"]) for t in svc.health.transitions] \
+            == [(MODE_FULL, MODE_BANK_PREFERRED),
+                (MODE_BANK_PREFERRED, MODE_FULL)]
+        assert svc.rollup()["mode_transitions"] == 2
+
+    def test_cache_only_serves_hot_hits_only(self, tmp_path):
+        model, params, train = _setup()
+        eng, banked = self._bank_engine(model, params, train, tmp_path)
+        misses = [tuple(p) for p in _unique_points(train, 20)
+                  if tuple(p) not in set(banked)][:2]
+        svc = _service(eng, max_batch=4, max_queue=64,
+                       health=self._health_cfg(err_cache_only=0.5))
+        # warm the hot cache in full mode (a clean dispatch — it also
+        # seeds the evidence window)
+        svc.submit(Request(*banked[0], id="warm"))
+        (warm,) = svc.drain()
+        assert warm.ok and warm.mode == MODE_FULL
+
+        # one shed drain on trusted evidence: error rate 0.5 hits
+        # err_cache_only and jumps straight past bank_preferred
+        with inject.active(
+            inject.Fault("serve.dispatch", at=0, kind=taxonomy.WORKER),
+            strict=True, validate=True,
+        ):
+            svc.submit(Request(*misses[0], id="m0"))
+            svc.drain()
+        assert svc.health.mode == MODE_CACHE_ONLY
+
+        svc.submit(Request(*banked[0], id="hot"))
+        svc.submit(Request(*banked[1], id="bank"))
+        got = {r.id: r for r in svc.drain()}
+        hot, bank = got["hot"], got["bank"]
+        # the hot hit still serves the exact bytes it was filled with;
+        # even a bank hit is miss-path work in cache_only and sheds
+        assert hot.ok and np.array_equal(np.asarray(hot.scores),
+                                         np.asarray(warm.scores))
+        assert not bank.ok and bank.reason == REASON_DEGRADED
+        assert hot.mode == bank.mode == MODE_CACHE_ONLY
+
+    def test_replayed_service_stream_sheds_identically(self, tmp_path):
+        """End-to-end determinism: the same submit/fault stream twice
+        gives the same transition log and the same shed set."""
+        model, params, train = _setup()
+
+        def episode(sub):
+            eng, banked = self._bank_engine(model, params, train,
+                                            tmp_path / sub)
+            misses = [tuple(p) for p in _unique_points(train, 20)
+                      if tuple(p) not in set(banked)][:3]
+            svc = _service(eng, max_batch=4, max_queue=64,
+                           health=self._health_cfg())
+            self._degrade(svc, misses[:2])
+            out = []
+            for n, p in enumerate([banked[0], misses[2], banked[1]]):
+                svc.submit(Request(*p, id=f"r{n}"))
+                out += svc.drain()
+            trs = [(t["from"], t["to"], t["tick"])
+                   for t in svc.health.transitions]
+            return [(r.id, r.status, r.reason, r.mode)
+                    for r in out], trs
+
+        assert episode("a") == episode("b")
