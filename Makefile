@@ -1,7 +1,7 @@
 # Convenience targets. The commands themselves are pinned in
 # ROADMAP.md (tier-1) and scripts/ — these targets just name them.
 
-.PHONY: tier1 test lint lint-io serve-smoke multichip-smoke factor-smoke chaos-smoke chaos-soak churn-smoke degraded-smoke
+.PHONY: tier1 test lint lint-io serve-smoke multichip-smoke factor-smoke chaos-smoke chaos-soak churn-smoke degraded-smoke kernel-smoke
 
 # The ROADMAP.md tier-1 verify: fast CPU suite, slow tests excluded.
 # Lint is fatal — a finding fails the build before pytest runs.
@@ -56,6 +56,13 @@ chaos-smoke:
 # bounded epoch-fence staleness window (docs/design.md §17).
 churn-smoke:
 	bash scripts/churn_smoke.sh
+
+# Kernel smoke: fused score-kernel parity on CPU (<60s) — Pallas
+# (interpret) + XLA analytic twin vs the vmapped-autodiff reference on
+# both block geometries, plus an XLA-twin serve round trip
+# (docs/design.md §19).
+kernel-smoke:
+	bash scripts/kernel_smoke.sh
 
 # Degraded smoke: the r12 survival paths on CPU (<60s, 8 virtual
 # devices) — one forced device loss (4-device mesh shrinks to 3,

@@ -564,6 +564,31 @@ def main():
                 device_split[st + "_us"] = round((cum - prev) * 1e6, 1)
                 prev = cum
             device_split["full_program_ms"] = round(prev * 1e3, 3)
+            device_split["kernel_variant"] = engine.active_kernel_variant()
+            # Grads-stage regression gate (ROADMAP item 2 / ISSUE 12):
+            # before the fused score kernels the per-example-gradient
+            # stage was ~90% of the device program; the committed
+            # budget after the kernel rework is < 50% of
+            # full_program_ms. Like drift_alert, the gate does not fail
+            # the run — it flags loudly so a regression lands in the
+            # artifact AND on stderr instead of eroding silently.
+            committed = 0.50
+            full = device_split["full_program_ms"]
+            frac = (device_split["grads_ms"] / full) if full > 0 else 0.0
+            device_split["grads_frac_of_program"] = round(frac, 4)
+            device_split["grads_frac_committed_max"] = committed
+            device_split["grads_gate_alert"] = frac > committed
+            if frac > committed:
+                print(
+                    f"bench: GRADS-STAGE ALERT — grads "
+                    f"{device_split['grads_ms']} ms is "
+                    f"{frac:.0%} of the {full} ms device program "
+                    f"(committed < {committed:.0%}; kernel variant "
+                    f"{device_split['kernel_variant']}). The "
+                    f"per-example-gradient wall is back — check the "
+                    f"kernel dispatch path before trusting this round.",
+                    file=sys.stderr,
+                )
             log.log("device_split", model="MF", **device_split)
         except Exception as e:  # noqa: BLE001
             device_split = {"error": repr(e)}
@@ -632,6 +657,7 @@ def main():
             ladder_pool = sample_heldout_pairs(train.x, users, items,
                                                4096, seed=31)
         rungs = (64, 256) if QUICK else (256, 1024, 4096)
+        dispatch["kernel_variant"] = engine.active_kernel_variant()
         dispatch["rungs"] = []
         for n in rungs:
             pts = ladder_pool[:n]

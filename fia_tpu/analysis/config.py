@@ -19,11 +19,31 @@ RAW_WRITE_ALLOWED = (
 # FIA2xx: jit entry points reached through indirection the AST cannot
 # follow (a method captured inside a ``vmap``/``partial`` assigned to a
 # local, then called from a jitted closure). Each entry is
-# (path suffix, bare function name); ``self`` is treated as static.
+# (path suffix, bare function name) with an optional third element
+# naming the static argument positions; when omitted, position 0 is
+# static (the bound-method ``self`` case).
 REGISTERED_JIT_ENTRY_POINTS = (
     # InfluenceEngine._query_one: vmapped via partial into the padded
     # per-bucket closures that _batched/_batched_packed jit.
     ("fia_tpu/influence/engine.py", "_query_one"),
+    # Fused score-kernel dispatch (influence/kernels): called from the
+    # engine's jitted _flat_fn/_bank_fn closures through the package
+    # dispatch table. ``model`` and the resolved ``variant`` string are
+    # trace-static — both are folded into the engine's jit cache key.
+    ("fia_tpu/influence/kernels/__init__.py", "fused_scores", (0, 1)),
+    ("fia_tpu/influence/kernels/__init__.py", "row_grads", (0, 1)),
+    # Per-geometry kernel wrappers (model static) and the Pallas kernel
+    # bodies themselves (every positional arg is a VMEM Ref; the
+    # geometry ints ride as keyword-only partial bindings).
+    ("fia_tpu/influence/kernels/mf.py", "fused_scores", (0,)),
+    ("fia_tpu/influence/kernels/mf.py", "_kernel", ()),
+    ("fia_tpu/influence/kernels/ncf.py", "fused_scores", (0,)),
+    ("fia_tpu/influence/kernels/ncf.py", "_kernel", ()),
+    # Shared kernel-body helpers and the pallas_call harness
+    # (kernel_body / shape ints / block_specs builder are static).
+    ("fia_tpu/influence/kernels/common.py", "onehot_fetch", (2,)),
+    ("fia_tpu/influence/kernels/common.py", "score_epilogue", (4,)),
+    ("fia_tpu/influence/kernels/common.py", "run_tiled", (0, 1, 2, 4)),
 )
 
 # FIA204: the registered dispatch hot path. These functions sit between

@@ -127,9 +127,13 @@ class JitIndex:
         from fia_tpu.analysis import config
 
         self.jitted_names: dict[str, tuple[int, ...]] = {}
-        for suffix, name in config.REGISTERED_JIT_ENTRY_POINTS:
+        for entry in config.REGISTERED_JIT_ENTRY_POINTS:
+            suffix, name = entry[0], entry[1]
+            # optional third element: explicit static positions; default
+            # (0,) covers the bound-method case (self static)
+            statics = tuple(entry[2]) if len(entry) > 2 else (0,)
             if sf.rel.endswith(suffix):
-                self.jitted_names[name] = (0,)  # bound method: self static
+                self.jitted_names[name] = statics
         if sf.tree is None:
             return
         for node in ast.walk(sf.tree):

@@ -273,10 +273,13 @@ class QueryCacheScenario(Scenario):
         self.test_ds = RatingDataset(
             self.pts[:2].copy(), np.full(2, 4.0, np.float32))
         # one engine for every run (jit caches shared); the disk cache
-        # tier is re-pointed into each run's workdir
+        # tier is re-pointed into each run's workdir. The score kernel
+        # is pinned (here and in every scenario below) rather than left
+        # on 'auto': golden runs are BITWISE contracts, and auto
+        # resolves per backend (pallas on TPU reorders accumulation).
         self.engine = InfluenceEngine(
             self.model, params, self.train, damping=_DAMP,
-            model_name="chaos-mf")
+            model_name="chaos-mf", kernel="xla_analytic")
 
     def run(self, workdir: str, events: list) -> dict:
         eng = self.engine
@@ -396,7 +399,7 @@ class ServeStreamScenario(Scenario):
         self.train_ds = RatingDataset(x, y)
         self.engine = InfluenceEngine(
             self.model, self.params, self.train_ds, damping=_DAMP,
-            model_name="chaos-serve")
+            model_name="chaos-serve", kernel="xla_analytic")
         # 12 distinct keys; the stream below replays some of them
         rng = np.random.default_rng(2)
         flat = rng.choice(_U * _I, size=12, replace=False)
@@ -533,7 +536,8 @@ class ServeStreamMeshScenario(ServeStreamScenario):
             self.mesh = make_mesh(self.NDEV)
             self.engine = InfluenceEngine(
                 self.model, self.params, self.train_ds, damping=_DAMP,
-                model_name="chaos-serve-mesh", mesh=self.mesh)
+                model_name="chaos-serve-mesh", mesh=self.mesh,
+                kernel="xla_analytic")
 
     def run(self, workdir: str, events: list) -> dict:
         if self.mesh is None:
@@ -626,7 +630,8 @@ class DeviceLossRecoveryScenario(ServeStreamScenario):
             self.mesh = make_mesh(self.NDEV)
             self.engine = InfluenceEngine(
                 self.model, self.params, self.train_ds, damping=_DAMP,
-                model_name="chaos-devloss", mesh=self.mesh)
+                model_name="chaos-devloss", mesh=self.mesh,
+                kernel="xla_analytic")
         # Domains are per-instance: device loss is benign (recovery is
         # a bit-identical re-dispatch) only when there is a mesh to
         # shrink. mesh.rebuild is deliberately NOT in any domain — the
@@ -757,7 +762,8 @@ class FactorBankScenario(Scenario):
         train = RatingDataset(x, y)
         builder = InfluenceEngine(
             self.model, params, train, damping=_DAMP,
-            model_name="chaos-factor", lissa_depth=30)
+            model_name="chaos-factor", lissa_depth=30,
+            kernel="xla_analytic")
         pairs = fbank.select_hot_pairs(
             builder.index, max_entries=self.NPAIRS,
             top_users=4, top_items=4)
@@ -781,7 +787,8 @@ class FactorBankScenario(Scenario):
             self.model, params, train, damping=_DAMP,
             solver="precomputed", cache_dir=tempfile.mkdtemp(
                 prefix="fia-chaos-factor-init-"),
-            model_name="chaos-factor", lissa_depth=30)
+            model_name="chaos-factor", lissa_depth=30,
+            kernel="xla_analytic")
 
         # fault-free references: bank-hit bytes and bank-less ladder
         # bytes per pair, each queried alone (T=1) so per-pair results
@@ -794,7 +801,8 @@ class FactorBankScenario(Scenario):
         ]
         ladder = InfluenceEngine(
             self.model, params, train, damping=_DAMP, solver="lissa",
-            model_name="chaos-factor", lissa_depth=30)
+            model_name="chaos-factor", lissa_depth=30,
+            kernel="xla_analytic")
         self.ref_ladder = [
             self._one(ladder, p).tobytes() for p in self.pairs
         ]

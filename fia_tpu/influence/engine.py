@@ -36,7 +36,9 @@ from fia_tpu.data.dataset import RatingDataset
 from fia_tpu.data.index import InteractionIndex, bucketed_pad
 from fia_tpu.influence import grads as G
 from fia_tpu.influence import hvp as H
+from fia_tpu.influence import kernels as K
 from fia_tpu.influence import solvers
+from fia_tpu.influence import spectral
 from fia_tpu.reliability import inject, sites, taxonomy
 from fia_tpu.reliability import policy as rpolicy
 from fia_tpu.reliability.journal import Journal  # noqa: F401 (re-export)
@@ -217,10 +219,33 @@ class InfluenceEngine:
         row_features: str = "auto",
         cpu_fallback: bool = True,
         query_bucket: int = 64,
+        kernel: str = "auto",
+        lissa_tune: str = "spectral",
     ):
         if solver not in ("direct", "cg", "lissa", "schulz", "precomputed"):
             raise ValueError(f"unknown solver {solver!r}")
         self.model = model
+        # Score-kernel variant for the flat/bank paths (influence/kernels/):
+        # 'auto' resolves to the fused Pallas kernel on TPU (models with
+        # a kernel family), the pure-XLA analytic twin elsewhere — op-
+        # for-op the historical score stage, so CPU golden runs are
+        # untouched — and the vmapped-autodiff reference for models
+        # without hooks. Explicit variants are for parity/bench runs;
+        # resolve_variant rejects impossible requests loudly.
+        if kernel not in ("auto",) + K.VARIANTS:
+            raise ValueError(f"unknown kernel {kernel!r}")
+        self.kernel = kernel
+        self._kernel_variant = K.resolve_variant(kernel, model)
+        # LiSSA tuning on the solver-ladder miss path: 'spectral' runs
+        # extreme_eigvals on the block HVP and derives (scale, shift)
+        # covering BOTH spectrum ends — indefinite blocks (λ_min < 0,
+        # reachable away from an optimum through the e·C cross term)
+        # make the Neumann recursion diverge at ANY scale, so the shift
+        # lifts the operator PD first; 'static' keeps the configured
+        # scale (plus solve_lissa's λ_max-only auto_scale guard).
+        if lissa_tune not in ("spectral", "static"):
+            raise ValueError(f"unknown lissa_tune {lissa_tune!r}")
+        self.lissa_tune = lissa_tune
         if shard_tables and (mesh is None or "model" not in mesh.axis_names):
             raise ValueError("shard_tables requires a mesh with a 'model' axis")
         # Fused per-train-row feature table for the flat path (see
@@ -585,6 +610,25 @@ class InfluenceEngine:
                 ihvp = solvers.solve_direct(Hmat, v)
         elif self.solver == "cg":
             ihvp = solvers.solve_cg(hvp, v, maxiter=self.cg_maxiter, tol=self.cg_tol)
+        elif self.lissa_tune == "spectral":
+            # Spectrum-aware tuning (the bank-miss rung lands here:
+            # QUERY_SOLVER_FALLBACK['precomputed'] == 'lissa'):
+            # extreme_eigvals gives BOTH ends of the block spectrum, so
+            # besides lifting scale past λ_max (which solve_lissa's own
+            # guard also does) an indefinite block — λ_min < 0 through
+            # the e·C cross term away from an optimum, where the
+            # recursion diverges at ANY scale — gets a PD shift folded
+            # into the operator; the result then solves the
+            # shift-damped system (H + shift·I)x = v, finite where the
+            # static config NaNs. PD blocks see shift ≈ 0 and keep the
+            # reference semantics.
+            scale, shift = spectral.lissa_tuning(
+                hvp, model.block_size, scale_floor=self.lissa_scale
+            )
+            ihvp = solvers.solve_lissa(
+                lambda x_, _s=shift: hvp(x_) + _s * x_, v, scale=scale,
+                recursion_depth=self.lissa_depth, auto_scale=False,
+            )
         else:
             # no num_samples here: the block HVP is DETERMINISTIC (full
             # related set every step), so averaged recursions would be
@@ -649,7 +693,8 @@ class InfluenceEngine:
         (docs/design.md §15).
         """
         use_feat = self._rowfeat is not None
-        key = ("flat", s_pad, stage, use_feat, donate)
+        variant = self._kernel_variant
+        key = ("flat", s_pad, stage, use_feat, donate, variant)
         if key in self._jitted:
             return self._jitted[key]
         if stage not in ("grads", "hessian", "solve", "scores"):
@@ -717,32 +762,24 @@ class InfluenceEngine:
             #    useful values — XLA's cost model put the multi-gather
             #    grads stage at 39 GB accessed vs ~1.5 GB fused
             #    (output/roofline_mf.json, r4)
-            #  - block_row_grads hook: one batched program over
-            #    gathered inputs
-            #  - vmapped autodiff: S single-row graphs; measured 92% of
-            #    MF flat-query device time (BENCH r4 device_split)
+            #  - kernels.row_grads: the analytic block_row_grads hook
+            #    (one batched program over gathered inputs), or the
+            #    vmapped-autodiff reference — S single-row graphs,
+            #    measured 92% of MF flat-query device time (BENCH r4
+            #    device_split) — per the engine's kernel variant.
+            # The Hessian stage consumes g tile-by-tile either way; the
+            # 'pallas' variant re-forms gradients in VMEM for the SCORE
+            # stage only (influence/kernels/), so g below still feeds
+            # the accumulation.
             if use_feat:
                 feat = rowfeat[row]
                 g, e, ma, mb = model.grads_from_row_features(feat, ut, it)
                 ab = wv * ma * mb
+                rel_x = train_x[row] if variant == "pallas" else None
             else:
                 rel_x = train_x[row]
                 rel_y = train_y[row]
-                if model.block_row_grads is not None:
-                    g = model.block_row_grads(params, ut, it, rel_x)
-                else:
-                    def one_g(xj, uu, ii):
-                        block0 = model.extract_block(params, uu, ii)
-
-                        def pred(bvec):
-                            block = model.unflatten_block(bvec, block0)
-                            return model.block_predict(
-                                params, block, uu, ii, xj[None, :]
-                            )[0]
-
-                        return jax.grad(pred)(model.flatten_block(block0))
-
-                    g = jax.vmap(one_g)(rel_x, ut, it)  # (S, d)
+                g = K.row_grads(model, variant, params, ut, it, rel_x)
                 e = model.predict(params, rel_x) - rel_y
                 ab = wv * (rel_x[:, 0] == ut) * (rel_x[:, 1] == it)
             if stage == "grads":
@@ -834,9 +871,10 @@ class InfluenceEngine:
                 )
             )(u, i)
             reg_dot = jnp.sum(theta * rdiag[None] * ihvp, axis=1)  # (T,)
-            scores = wv * (
-                2.0 * e * jnp.einsum("sd,sd->s", g, ihvp[t]) + reg_dot[t]
-            ) / n_t[t]
+            scores = K.fused_scores(
+                model, variant, params, ut, it, t, rel_x, e, wv,
+                ihvp, reg_dot, n_t, g=g,
+            )
             return scores, ihvp, v
 
         if mesh is None:
@@ -976,11 +1014,20 @@ class InfluenceEngine:
 
         return mesh_fingerprint(self.mesh)
 
+    def active_kernel_variant(self) -> str:
+        """The resolved score-kernel variant ('pallas' /
+        'xla_analytic' / 'vmap_autodiff') — bench/serve report it so
+        perf trajectories across kernel generations stay comparable."""
+        return self._kernel_variant
+
     def _aot_key(self, t_pad: int, s_pad: int):
         # mesh fingerprint LAST: warmup/compiled_geometries index the
-        # geometry as (k[1], k[2]) — appending keeps those stable
+        # geometry as (k[1], k[2]) — appending keeps those stable; the
+        # kernel variant sits before it so a variant flip (e.g. a
+        # post-recovery CPU rebuild) can never serve a stale executable
         return ("flat", t_pad, s_pad, self._rowfeat is not None,
-                self._donate_scratch(), self._mesh_fp())
+                self._donate_scratch(), self._kernel_variant,
+                self._mesh_fp())
 
     def precompile_flat(self, geometries) -> dict:
         """AOT pre-lower + compile flat programs for ``(t_pad, s_pad)``
@@ -1189,6 +1236,11 @@ class InfluenceEngine:
                     pad_bucket=self.pad_bucket,
                     hessian_mode="auto",
                     impl="auto",
+                    # never interpret-mode Pallas in production: a
+                    # forced-pallas engine degrades to the XLA twin on
+                    # the CPU rung ('auto' resolves it there)
+                    kernel="auto" if self.kernel == "pallas" else self.kernel,
+                    lissa_tune=self.lissa_tune,
                 )
                 eng._is_cpu_fallback = True
             self._cpu_engine = eng
@@ -1694,6 +1746,8 @@ class InfluenceEngine:
                 row_features=self.row_features,
                 cpu_fallback=self.cpu_fallback,
                 query_bucket=self.query_bucket,
+                kernel=self.kernel,
+                lissa_tune=self.lissa_tune,
             )
         return self._bank_delegate
 
@@ -1722,7 +1776,8 @@ class InfluenceEngine:
         flat program's, so hit results keep the packed layout and the
         assembly/corruption seams downstream."""
         use_feat = self._rowfeat is not None
-        key = ("flatbank", s_pad, use_feat)
+        variant = self._kernel_variant
+        key = ("flatbank", s_pad, use_feat, variant)
         if key in self._jitted:
             return self._jitted[key]
         from jax.scipy.linalg import cho_solve
@@ -1765,24 +1820,17 @@ class InfluenceEngine:
             if use_feat:
                 feat = rowfeat[row]
                 g, e, _, _ = model.grads_from_row_features(feat, ut, it)
+                rel_x = train_x[row] if variant == "pallas" else None
             else:
                 rel_x = train_x[row]
                 rel_y = train_y[row]
-                if model.block_row_grads is not None:
-                    g = model.block_row_grads(params, ut, it, rel_x)
-                else:
-                    def one_g(xj, uu, ii):
-                        block0 = model.extract_block(params, uu, ii)
-
-                        def pred(bvec):
-                            block = model.unflatten_block(bvec, block0)
-                            return model.block_predict(
-                                params, block, uu, ii, xj[None, :]
-                            )[0]
-
-                        return jax.grad(pred)(model.flatten_block(block0))
-
-                    g = jax.vmap(one_g)(rel_x, ut, it)
+                # no Hessian stage on the bank hot path: under the
+                # fused kernel the (S, d) gradient matrix is never
+                # formed at all — rows rebuild inside VMEM tiles
+                g = (
+                    None if variant == "pallas"
+                    else K.row_grads(model, variant, params, ut, it, rel_x)
+                )
                 e = model.predict(params, rel_x) - rel_y
 
             v = jax.vmap(
@@ -1806,9 +1854,10 @@ class InfluenceEngine:
                 )
             )(u, i)
             reg_dot = jnp.sum(theta * rdiag[None] * ihvp, axis=1)
-            scores = wv * (
-                2.0 * e * jnp.einsum("sd,sd->s", g, ihvp[t]) + reg_dot[t]
-            ) / n_t[t]
+            scores = K.fused_scores(
+                model, variant, params, ut, it, t, rel_x, e, wv,
+                ihvp, reg_dot, n_t, g=g,
+            )
             return scores, ihvp, v
 
         self._jitted[key] = jax.jit(fn)

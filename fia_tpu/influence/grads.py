@@ -61,6 +61,34 @@ def per_example_block_loss_grads(model, params, u, i, x, y):
     return jax.vmap(one)(x, y)
 
 
+def autodiff_row_grads(model, params, u, i, x):
+    """(B, d) per-row block Jacobian by vmapped single-row autodiff.
+
+    The *definitional* form of ``block_row_grads``: row j's gradient is
+    ``jax.grad`` of its own one-row prediction w.r.t. the flattened
+    block. Every faster path — the model's closed-form hook, the fused
+    Pallas score kernels (influence/kernels/) — is parity-tested
+    against this function, so it must stay the plain-AD transcription
+    of the definition. ``u``/``i`` may be scalars or (B,) per-row
+    query ids aligned with ``x`` (the flat engine's layout).
+    """
+    u_arr = jnp.asarray(u)
+    per_row_ids = u_arr.ndim > 0
+
+    def one(xj, uu, ii):
+        block0 = model.extract_block(params, uu, ii)
+
+        def pred(bvec):
+            block = model.unflatten_block(bvec, block0)
+            return model.block_predict(params, block, uu, ii, xj[None, :])[0]
+
+        return jax.grad(pred)(model.flatten_block(block0))
+
+    if per_row_ids:
+        return jax.vmap(one)(x, u, i)
+    return jax.vmap(lambda xj: one(xj, u, i))(x)
+
+
 def per_example_block_prediction_grads(model, params, u, i, x):
     """(B, d) matrix of g_j = ∇_block r̂(z_j), one row per example.
 
@@ -69,22 +97,12 @@ def per_example_block_prediction_grads(model, params, u, i, x):
     exact for models whose prediction is piecewise-linear in the block.
     Routes through the model's ``block_row_grads`` hook when defined
     (one batched program instead of B vmapped single-row graphs — see
-    models/base.py hook doc); the autodiff fallback remains the
+    models/base.py hook doc); :func:`autodiff_row_grads` remains the
     definition the hook is regression-tested against.
     """
     if model.block_row_grads is not None:
         return model.block_row_grads(params, u, i, x)
-    block0 = model.extract_block(params, u, i)
-    bvec0 = model.flatten_block(block0)
-
-    def one(xj):
-        def pred(bvec):
-            block = model.unflatten_block(bvec, block0)
-            return model.block_predict(params, block, u, i, xj[None, :])[0]
-
-        return jax.grad(pred)(bvec0)
-
-    return jax.vmap(one)(x)
+    return autodiff_row_grads(model, params, u, i, x)
 
 
 def per_example_full_loss_grads(model, params, x, y):

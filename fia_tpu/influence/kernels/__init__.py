@@ -1,0 +1,146 @@
+"""Fused score kernels for the flat influence path.
+
+The score stage of the flat program computes, for every flat related
+row s owned by query t,
+
+    score_s = wv_s * (2 e_s (g_s · ihvp_t) + reg_dot_t) / n_t
+
+with g_s the row's closed-form block gradient. The vmapped-autodiff
+form of that stage (S single-row grad graphs feeding an (S, d)
+matrix in HBM, then an (S, d) gather-expand of ihvp) was measured at
+~90% of the flat query's device program ("Scaling Up Influence
+Functions", arXiv:2112.03052, finds the same wall at pod scale). This
+package collapses it into one of three interchangeable *variants*:
+
+  - ``pallas``: one fused Pallas TPU kernel per block geometry
+    (``kernels/mf.py`` / ``kernels/ncf.py``) — raw embedding rows
+    stream through VMEM tiles, per-row gradients form *in registers*
+    from the closed-form block losses, and per-query operands arrive
+    by an in-kernel one-hot MXU matmul, so neither the (S, d) gradient
+    matrix nor the (S, d) ihvp expansion ever touches HBM. On non-TPU
+    backends the same kernel runs under ``interpret=True`` (tests
+    only — production CPU serves the XLA twin).
+  - ``xla_analytic``: the pure-XLA twin — the model's
+    ``block_row_grads`` hook plus the reference einsum, op-for-op the
+    engine's historical default, so it is the always-available
+    fallback AND the bit-exactness anchor for golden runs.
+  - ``vmap_autodiff``: the definitional reference (vmapped
+    ``jax.grad`` over single-row graphs) every faster variant is
+    parity-tested against (tests/test_kernels.py).
+
+Selection is engine-level (``InfluenceEngine(kernel=...)``) and folds
+into both the jit cache keys and the AOT ``_aot_key``, so
+``precompile_flat`` / mesh dispatch / ``rebuild_mesh`` keep their
+zero-steady-state-compile contract per variant.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from fia_tpu.influence.grads import autodiff_row_grads
+
+VARIANTS = ("pallas", "xla_analytic", "vmap_autodiff")
+
+_PALLAS_FAMILIES = ("mf", "ncf")
+
+
+def supports_pallas(model) -> bool:
+    """A fused Pallas kernel exists for this block geometry: the model
+    declares a kernel family this package implements and the gather /
+    closed-form hooks the kernel body needs."""
+    return (
+        getattr(model, "kernel_family", None) in _PALLAS_FAMILIES
+        and model.kernel_row_inputs is not None
+        and model.block_row_grads is not None
+    )
+
+
+def resolve_variant(requested: str, model, backend: str | None = None) -> str:
+    """Resolve an engine-level ``kernel`` request to a concrete variant.
+
+    ``auto`` picks the fused Pallas kernel on TPU when the model's
+    geometry has one, the XLA analytic twin when the model defines
+    ``block_row_grads`` (every non-TPU production backend — interpret
+    mode is a test vehicle, not a serving path), and the autodiff
+    reference otherwise. Explicit requests are honored or rejected
+    loudly — a silently substituted variant would invalidate a parity
+    run without telling anyone.
+    """
+    if backend is None:
+        backend = jax.default_backend()
+    if requested == "auto":
+        if backend == "tpu" and supports_pallas(model):
+            return "pallas"
+        if model.block_row_grads is not None:
+            return "xla_analytic"
+        return "vmap_autodiff"
+    if requested not in VARIANTS:
+        raise ValueError(f"unknown kernel variant {requested!r}")
+    if requested == "pallas" and not supports_pallas(model):
+        raise ValueError(
+            f"{type(model).__name__} has no fused Pallas score kernel "
+            "(needs kernel_family + kernel_row_inputs + block_row_grads)"
+        )
+    if requested == "xla_analytic" and model.block_row_grads is None:
+        raise ValueError(
+            f"{type(model).__name__} defines no block_row_grads hook — "
+            "the analytic variant has nothing to run"
+        )
+    return requested
+
+
+def row_grads(model, variant: str, params, ut, it, rel_x) -> jnp.ndarray:
+    """(S, d) per-row block gradients for the Hessian/grads stages.
+
+    The Pallas variant never materializes these for *scoring* — but the
+    flat program's Hessian accumulation still consumes g row-tiles, so
+    it shares the analytic hook here (the bank hot path has no Hessian
+    stage and skips this entirely).
+    """
+    if variant != "vmap_autodiff" and model.block_row_grads is not None:
+        return model.block_row_grads(params, ut, it, rel_x)
+    return autodiff_row_grads(model, params, ut, it, rel_x)
+
+
+def fused_scores(
+    model,
+    variant: str,
+    params,
+    ut,
+    it,
+    t,
+    rel_x,
+    e,
+    wv,
+    ihvp,
+    reg_dot,
+    n_t,
+    g=None,
+):
+    """The score stage: (S,) influence scores for flat rows.
+
+    ``ut``/``it`` are the per-row owning-query ids, ``t`` the segment
+    ids, ``e``/``wv`` the residuals and validity mask, ``ihvp`` (T, d),
+    ``reg_dot``/``n_t`` (T,). ``g`` is an already-materialized (S, d)
+    gradient matrix when the caller has one (the flat program computed
+    it for the Hessian stage); the XLA/autodiff variants reuse it
+    op-for-op — bit-identical to the historical inline einsum — while
+    the Pallas variant ignores it and re-forms gradients in VMEM from
+    ``rel_x`` + the resident tables (recompute-over-HBM-traffic, the
+    standard fusion trade).
+    """
+    if variant == "pallas":
+        from fia_tpu.influence.kernels import mf as _mf
+        from fia_tpu.influence.kernels import ncf as _ncf
+
+        impl = {"mf": _mf, "ncf": _ncf}[model.kernel_family]
+        return impl.fused_scores(
+            model, params, ut, it, t, rel_x, e, wv, ihvp, reg_dot, n_t
+        )
+    if g is None:
+        g = row_grads(model, variant, params, ut, it, rel_x)
+    return wv * (
+        2.0 * e * jnp.einsum("sd,sd->s", g, ihvp[t]) + reg_dot[t]
+    ) / n_t[t]

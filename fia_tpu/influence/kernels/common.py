@@ -1,0 +1,120 @@
+"""Shared plumbing for the fused Pallas score kernels.
+
+Both block geometries (kernels/mf.py, kernels/ncf.py) run the same
+harness: the flat row axis S is cut into row tiles that Pallas
+pipelines through VMEM, per-row float operands travel as one packed
+(S, 4) matrix ``[e, wv, a, b]``, segment ids as an (S, 1) int32
+column, and every per-QUERY operand — the (T, d) iHVP, the (T,)
+regulariser dot and segment size — as one augmented
+``B = [ihvp | reg_dot | n_t]`` (T, d + 2) matrix resident in VMEM for
+every grid step. Inside the kernel a row tile fetches its queries'
+rows of B with a one-hot (TILE, T) @ (T, d+2) MXU matmul — the same
+one-hot-over-scatter trade the engine's Hessian accumulation uses
+(engine.py ``body_onehot``) — so the (S, d) gather-expand of the iHVP
+never exists in HBM. See docs/design.md §19 for the memory plan.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# One-hot staging buffer budget: TILE · T fp32 elements per grid step.
+# 2^20 elements = 4 MB — comfortably inside a ~16 MB VMEM core budget
+# next to the row tile, B, and the geometry's weight operands.
+_ONEHOT_BUDGET_ELEMS = 1 << 20
+_MAX_TILE = 512
+_SUBLANE = 8  # fp32 sublane quantum; row tiles stay multiples of it
+
+
+def pick_tile(s_pad: int, t_pad: int) -> int:
+    """Largest power-of-two row tile that divides ``s_pad``, capped by
+    the one-hot VMEM budget for this query count. The flat pad is a
+    multiple of 2048 in production (engine `_s_pad_for`), so this is
+    normally 512 at T ≤ 2048 and halves as T grows."""
+    budget = max(_SUBLANE, _ONEHOT_BUDGET_ELEMS // max(int(t_pad), 1))
+    tile = 1
+    while (
+        tile * 2 <= min(_MAX_TILE, budget)
+        and s_pad % (tile * 2) == 0
+    ):
+        tile *= 2
+    return tile
+
+
+def pad_rows(s: int) -> int:
+    """Round the flat row count up to the sublane quantum so direct
+    (test-sized) invocations tile cleanly; padded rows carry wv = 0 and
+    score 0 by construction."""
+    return -(-s // _SUBLANE) * _SUBLANE
+
+
+def pack_scalars(e, wv, a, b) -> jnp.ndarray:
+    """(S, 4) fp32 per-row operand pack: residual, validity, user and
+    item match masks — one streamed input instead of four 1-wide ones."""
+    return jnp.stack(
+        [
+            e.astype(jnp.float32),
+            wv.astype(jnp.float32),
+            a.astype(jnp.float32),
+            b.astype(jnp.float32),
+        ],
+        axis=1,
+    )
+
+
+def query_matrix(ihvp, reg_dot, n_t) -> jnp.ndarray:
+    """Augmented per-query operand ``B = [ihvp | reg_dot | n_t]``,
+    (T, d + 2). The kernel divides by the n_t column (rather than
+    multiplying by a precomputed reciprocal) to keep the epilogue the
+    same arithmetic as the XLA twin's ``/ n_t[t]``."""
+    return jnp.concatenate(
+        [ihvp, reg_dot[:, None], n_t[:, None]], axis=1
+    ).astype(jnp.float32)
+
+
+def interpret_mode() -> bool:
+    """Pallas interpret mode everywhere but real TPUs: the kernels are
+    *testable* on CPU (parity vs the XLA twin, tests/test_kernels.py)
+    without pretending interpret execution is a serving path."""
+    return jax.default_backend() != "tpu"
+
+
+def onehot_fetch(t_col, B_ref, t_pad: int) -> jnp.ndarray:
+    """(TILE, d+2) per-row rows of B via a one-hot MXU matmul.
+
+    ``t_col`` is the (TILE, 1) int32 segment-id block;
+    ``broadcasted_iota`` rather than 1-D ``iota`` — TPU requires ≥ 2D
+    iota (see /opt/skills/guides/pallas_guide.md).
+    """
+    onehot = (
+        t_col == jax.lax.broadcasted_iota(jnp.int32, (t_col.shape[0], t_pad), 1)
+    ).astype(jnp.float32)
+    return jnp.dot(onehot, B_ref[...], preferred_element_type=jnp.float32)
+
+
+def score_epilogue(gdot, e, wv, P, d: int) -> jnp.ndarray:
+    """Shared kernel epilogue: (TILE,) scores from the per-row
+    gradient·iHVP dot and the fetched B rows —
+    wv · (2 e gdot + reg_dot) / n_t."""
+    reg_dot = P[:, d]
+    n_t = P[:, d + 1]
+    return wv * (2.0 * e * gdot + reg_dot) / n_t
+
+
+def run_tiled(kernel_body, s_pad: int, t_pad: int, inputs, block_specs,
+              *, interpret: bool):
+    """``pallas_call`` harness shared by the geometries: grid over row
+    tiles, (S, 1) fp32 score output."""
+    from jax.experimental import pallas as pl
+
+    tile = pick_tile(s_pad, t_pad)
+    grid = (s_pad // tile,)
+    return pl.pallas_call(
+        kernel_body,
+        grid=grid,
+        in_specs=block_specs(pl, tile),
+        out_specs=pl.BlockSpec((tile, 1), lambda s: (s, 0)),
+        out_shape=jax.ShapeDtypeStruct((s_pad, 1), jnp.float32),
+        interpret=interpret,
+    )(*inputs)

@@ -1,0 +1,79 @@
+"""Fused Pallas score kernel for the MF block geometry.
+
+The MF per-row block gradient is closed-form gathers + masks
+(models/mf.py ``block_row_grads``):
+
+    g_j = [a_j Q[i_j] ; b_j P[u_j] ; a_j ; b_j],  d = 2k + 2
+
+so the score dot g_j · ihvp_t splits into two masked k-row dots plus
+two bias picks — no (S, d) matrix needed. Each grid step streams one
+(TILE, 2k) tile of pre-gathered raw rows ``[Q[i_j] | P[u_j]]`` plus
+the (TILE, 4) scalar pack through VMEM, one-hot-fetches its queries'
+``[ihvp | reg_dot | n_t]`` rows on the MXU, and writes the finished
+(TILE, 1) score column; the gradient exists only in registers.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax.numpy as jnp
+
+from fia_tpu.influence.kernels import common
+
+
+def _kernel(rows_ref, scal_ref, t_ref, B_ref, out_ref, *, k: int, d: int,
+            t_pad: int):
+    P = common.onehot_fetch(t_ref[...], B_ref, t_pad)  # (TILE, d + 2)
+    rows = rows_ref[...]
+    scal = scal_ref[...]
+    e, wv, a, b = scal[:, 0], scal[:, 1], scal[:, 2], scal[:, 3]
+    # g · ihvp, term by term: the pu slice of the iHVP dots the row's
+    # item embedding (and vice versa), biases pick single entries
+    gdot = a * (jnp.sum(rows[:, :k] * P[:, :k], axis=1) + P[:, 2 * k]) + b * (
+        jnp.sum(rows[:, k:] * P[:, k : 2 * k], axis=1) + P[:, 2 * k + 1]
+    )
+    out_ref[...] = common.score_epilogue(gdot, e, wv, P, d)[:, None]
+
+
+def fused_scores(model, params, ut, it, t, rel_x, e, wv, ihvp, reg_dot, n_t):
+    """(S,) fused scores for the MF geometry (see package doc for the
+    operand contract)."""
+    k = int(model.embedding_size)
+    d = int(model.block_size)
+    t_pad = ihvp.shape[0]
+    rows = model.kernel_row_inputs(params, rel_x)  # (S, 2k) [Q[i]|P[u]]
+    a = (rel_x[:, 0] == ut).astype(jnp.float32)
+    b = (rel_x[:, 1] == it).astype(jnp.float32)
+    scal = common.pack_scalars(e, wv, a, b)
+    t2 = t.astype(jnp.int32)[:, None]
+    B = common.query_matrix(ihvp, reg_dot, n_t)
+
+    S = rows.shape[0]
+    s_pad = common.pad_rows(S)
+    # fialint: disable=FIA202 -- static shape ints; pad choice is per-geometry
+    if s_pad != S:
+        # zero-padded rows carry wv = 0 and segment 0 — they fetch a
+        # real B row and score exactly 0, then slice away
+        pad = [(0, s_pad - S), (0, 0)]
+        rows = jnp.pad(rows, pad)
+        scal = jnp.pad(scal, pad)
+        t2 = jnp.pad(t2, pad)
+
+    def block_specs(pl, tile):
+        return [
+            pl.BlockSpec((tile, 2 * k), lambda s: (s, 0)),
+            pl.BlockSpec((tile, 4), lambda s: (s, 0)),
+            pl.BlockSpec((tile, 1), lambda s: (s, 0)),
+            pl.BlockSpec((t_pad, d + 2), lambda s: (0, 0)),
+        ]
+
+    out = common.run_tiled(
+        functools.partial(_kernel, k=k, d=d, t_pad=t_pad),
+        s_pad,
+        t_pad,
+        (rows, scal, t2, B),
+        block_specs,
+        interpret=common.interpret_mode(),
+    )
+    return out[:S, 0]

@@ -1,0 +1,118 @@
+"""Fused Pallas score kernel for the NCF block geometry.
+
+The NCF per-row block gradient is one closed-form MLP backward
+(models/ncf.py ``_own_grads`` / ``block_row_grads``): with
+z1 = [pm|qm] W1 + b1, h1 = relu(z1), z2 = h1 W2 + b2, and W3 split
+into its h2 rows w3h and GMF rows w3g,
+
+    dz2 = [z2 > 0] ⊙ w3h          dh_in = ([z1 > 0] ⊙ (dz2 W2ᵀ)) W1ᵀ
+    g_j = [a_j dh_in[:k] ; b_j dh_in[k:] ; a_j (qg ⊙ w3g) ; b_j (pg ⊙ w3g)]
+
+— three tile-batched MXU matmuls per row tile, entirely in VMEM. Each
+grid step streams a (TILE, 4k) tile of the four pre-gathered raw
+embedding rows ``[P_mlp[u_j] | Q_mlp[i_j] | P_gmf[u_j] | Q_gmf[i_j]]``,
+re-derives the forward masks, forms the gradient in registers, and
+dots it against the one-hot-fetched iHVP rows; the MLP weights ride
+along as whole-array VMEM operands (a few hundred KB at reference
+sizes).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from fia_tpu.influence.kernels import common
+
+
+def _kernel(rows_ref, scal_ref, t_ref, B_ref, W1_ref, b1_ref, W2_ref,
+            b2_ref, W3_ref, out_ref, *, k: int, k2: int, d: int,
+            t_pad: int):
+    f32 = jnp.float32
+    rows = rows_ref[...]
+    scal = scal_ref[...]
+    e, wv, a, b = scal[:, 0], scal[:, 1], scal[:, 2], scal[:, 3]
+
+    # forward to the relu masks (biases matter only through the masks)
+    hin = rows[:, : 2 * k]
+    z1 = jnp.dot(hin, W1_ref[...], preferred_element_type=f32) + b1_ref[...]
+    h1 = jnp.maximum(z1, 0.0)
+    z2 = jnp.dot(h1, W2_ref[...], preferred_element_type=f32) + b2_ref[...]
+
+    W3 = W3_ref[...]
+    w3h = W3[:k2, 0]
+    w3g = W3[k2:, 0]
+    # backward: relu' = [z > 0] (matches jax.nn.relu's grad at 0)
+    dz2 = jnp.where(z2 > 0.0, w3h[None, :], 0.0)
+    dh1 = jax.lax.dot_general(
+        dz2, W2_ref[...], (((1,), (1,)), ((), ())),
+        preferred_element_type=f32,
+    )
+    dz1 = jnp.where(z1 > 0.0, dh1, 0.0)
+    dhin = jax.lax.dot_general(
+        dz1, W1_ref[...], (((1,), (1,)), ((), ())),
+        preferred_element_type=f32,
+    )  # (TILE, 2k): the (dpm | dqm) own-gradients
+
+    pg = rows[:, 2 * k : 3 * k]
+    qg = rows[:, 3 * k :]
+    P = common.onehot_fetch(t_ref[...], B_ref, t_pad)  # (TILE, d + 2)
+    gdot = a * (
+        jnp.sum(dhin[:, :k] * P[:, :k], axis=1)
+        + jnp.sum(qg * w3g[None, :] * P[:, 2 * k : 3 * k], axis=1)
+    ) + b * (
+        jnp.sum(dhin[:, k:] * P[:, k : 2 * k], axis=1)
+        + jnp.sum(pg * w3g[None, :] * P[:, 3 * k :d], axis=1)
+    )
+    out_ref[...] = common.score_epilogue(gdot, e, wv, P, d)[:, None]
+
+
+def fused_scores(model, params, ut, it, t, rel_x, e, wv, ihvp, reg_dot, n_t):
+    """(S,) fused scores for the NCF geometry (see package doc for the
+    operand contract)."""
+    k = int(model.embedding_size)
+    d = int(model.block_size)
+    t_pad = ihvp.shape[0]
+    rows = model.kernel_row_inputs(params, rel_x)  # (S, 4k)
+    W1, b1, W2, b2, W3 = model.kernel_aux(params)
+    k2 = W2.shape[1]
+    a = (rel_x[:, 0] == ut).astype(jnp.float32)
+    b = (rel_x[:, 1] == it).astype(jnp.float32)
+    scal = common.pack_scalars(e, wv, a, b)
+    t2 = t.astype(jnp.int32)[:, None]
+    B = common.query_matrix(ihvp, reg_dot, n_t)
+
+    S = rows.shape[0]
+    s_pad = common.pad_rows(S)
+    # fialint: disable=FIA202 -- static shape ints; pad choice is per-geometry
+    if s_pad != S:
+        pad = [(0, s_pad - S), (0, 0)]
+        rows = jnp.pad(rows, pad)
+        scal = jnp.pad(scal, pad)
+        t2 = jnp.pad(t2, pad)
+
+    def block_specs(pl, tile):
+        whole = lambda s: (0, 0)
+        return [
+            pl.BlockSpec((tile, 4 * k), lambda s: (s, 0)),
+            pl.BlockSpec((tile, 4), lambda s: (s, 0)),
+            pl.BlockSpec((tile, 1), lambda s: (s, 0)),
+            pl.BlockSpec((t_pad, d + 2), whole),
+            pl.BlockSpec(W1.shape, whole),
+            pl.BlockSpec(b1.shape, whole),
+            pl.BlockSpec(W2.shape, whole),
+            pl.BlockSpec(b2.shape, whole),
+            pl.BlockSpec(W3.shape, whole),
+        ]
+
+    out = common.run_tiled(
+        functools.partial(_kernel, k=k, k2=k2, d=d, t_pad=t_pad),
+        s_pad,
+        t_pad,
+        (rows, scal, t2, B, W1, b1, W2, b2, W3),
+        block_specs,
+        interpret=common.interpret_mode(),
+    )
+    return out[:S, 0]

@@ -207,6 +207,22 @@ class LatentFactorModel:
     #: user/item match indicators, ARE the per-row block gradients.
     block_row_grads = None
 
+    #: optional fused-score-kernel hooks (influence/kernels/): a model
+    #: whose ``block_row_grads`` is closed-form over its own gathered
+    #: embedding rows can let the Pallas score kernel re-form the
+    #: per-row gradients inside VMEM instead of materialising an (S, d)
+    #: matrix in HBM. ``kernel_family`` names the kernel body
+    #: ("mf" / "ncf"); ``kernel_row_inputs(params, x) -> (B, R)``
+    #: gathers the raw embedding rows the kernel's gradient form reads,
+    #: in the layout that family documents; ``kernel_aux(params)``
+    #: returns the (small, 2-D) non-embedding weight operands the
+    #: kernel needs resident in VMEM (empty tuple when none).
+    kernel_family: str | None = None
+    kernel_row_inputs = None
+
+    def kernel_aux(self, params: Params) -> tuple:
+        return ()
+
     def block_loss(self, params: Params, block: Block, u, i, x, y, w=None):
         err = self.indiv_loss_from_pred(
             self.block_predict(params, block, u, i, x), y

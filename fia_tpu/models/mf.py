@@ -176,6 +176,18 @@ class MF(LatentFactorModel):
             axis=1,
         )
 
+    # -- fused score-kernel hooks (see base doc + influence/kernels/mf.py):
+    # the kernel re-forms g_j = [a Q[i_j]; b P[u_j]; a; b] in VMEM from
+    # the raw rows, so the gather ships them in that order.
+    kernel_family = "mf"
+
+    def kernel_row_inputs(self, params, x):
+        """(B, 2k) raw rows ``[Q[i_j] | P[u_j]]`` — the two embedding
+        gathers the closed-form row gradient is built from."""
+        return jnp.concatenate(
+            [params["Q"][x[:, 1]], params["P"][x[:, 0]]], axis=1
+        )
+
     # -- fused row-feature hooks (see base doc): one wide gather feeds
     # the flat influence program instead of ~8 tile-amplified ones.
     # Layout: [Q[i_j] (k) | P[u_j] (k) | e_j | u_j | i_j], F = 2k+3.

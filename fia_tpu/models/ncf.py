@@ -176,6 +176,31 @@ class NCF(LatentFactorModel):
             (xi == i).astype(jnp.float32),
         )
 
+    # -- fused score-kernel hooks (see base doc + influence/kernels/ncf.py):
+    # the kernel replays the forward to the relu masks and runs the
+    # closed-form backward per VMEM tile, so it needs the four raw rows
+    # plus the (small) MLP weights as resident operands.
+    kernel_family = "ncf"
+
+    def kernel_row_inputs(self, params, x):
+        """(B, 4k) raw rows
+        ``[P_mlp[u_j] | Q_mlp[i_j] | P_gmf[u_j] | Q_gmf[i_j]]``."""
+        xu, xi = x[:, 0], x[:, 1]
+        return jnp.concatenate(
+            [params["P_mlp"][xu], params["Q_mlp"][xi],
+             params["P_gmf"][xu], params["Q_gmf"][xi]],
+            axis=1,
+        )
+
+    def kernel_aux(self, params):
+        """MLP weight operands for the kernel, biases lifted to 2-D
+        (TPU Pallas wants >= 2-D VMEM operands)."""
+        return (
+            params["W1"], params["b1"][None, :],
+            params["W2"], params["b2"][None, :],
+            params["W3"],
+        )
+
     # -- fused row-feature hooks (see base doc). Layout:
     # [g_pm (k) | g_qm (k) | g_pg (k) | g_qg (k) | e | u | i], F = 4k+3,
     # with the g_* the row's OWN-embedding prediction gradients (the

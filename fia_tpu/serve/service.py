@@ -891,6 +891,11 @@ class InfluenceService:
             "seconds": round(time.perf_counter() - t0, 3),
             "planned_geometries": planned,
             "aot": aot,
+            # which score-kernel variant the armed programs embed
+            # (influence/kernels/) — smoke/ops checks pin it so a
+            # production TPU pod never silently serves the autodiff
+            # reference after a model/config drift
+            "kernel_variant": eng.active_kernel_variant(),
             "factor_bank_entries": bank_entries,
             "all_planned_compiled": (
                 all(tuple(g) in armed for g in planned) if flat_ok

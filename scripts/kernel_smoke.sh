@@ -1,0 +1,81 @@
+#!/usr/bin/env bash
+# Fused score-kernel smoke (docs/design.md §19), asserting on CPU:
+#   - Pallas kernels (interpret mode) match the vmapped-autodiff
+#     reference on BOTH block geometries: allclose + Spearman 1.0 per
+#     query, including a zero-count (all-masked) query
+#   - the XLA analytic twin — the CPU production variant — is BITWISE
+#     equal to the reference at engine level
+#   - a service warmed on the default kernel reports the twin as its
+#     active variant and serves a small batch end to end
+#
+#   bash scripts/kernel_smoke.sh        (or: make kernel-smoke)
+#
+# Budget: <60s on CPU — tiny synthetic problems, no training loop
+# (random-init params are exactly as good for parity).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+JAX_PLATFORMS=cpu timeout -k 10 300 python - <<'PY'
+import numpy as np
+import jax
+
+from fia_tpu.data.dataset import RatingDataset
+from fia_tpu.eval.metrics import spearman
+from fia_tpu.influence.engine import InfluenceEngine
+from fia_tpu.models import MF, NCF
+from fia_tpu.serve import InfluenceService, Request, ServeConfig
+
+U, I, K = 24, 18, 4
+rng = np.random.default_rng(0)
+x = np.stack([rng.integers(0, U - 1, 400), rng.integers(0, I - 1, 400)],
+             axis=1).astype(np.int32)
+y = rng.integers(1, 6, 400).astype(np.float32)
+train = RatingDataset(x, y)
+pts = np.concatenate(
+    [train.x[rng.choice(400, 9, replace=False)], [[U - 1, I - 1]]]
+).astype(np.int64)  # last query: zero related rows (all-masked)
+
+for name, model in (("MF", MF(U, I, K, 1e-3)), ("NCF", NCF(U, I, K, 1e-3))):
+    params = model.init_params(jax.random.PRNGKey(0))
+
+    def run(kernel):
+        eng = InfluenceEngine(model, params, train, damping=1e-3,
+                              kernel=kernel)
+        return eng.query_batch(pts)
+
+    ref = run("vmap_autodiff")
+    twin = run("xla_analytic")
+    pal = run("pallas")
+    assert np.array_equal(twin.ihvp, ref.ihvp), f"{name}: twin ihvp drift"
+    for t in range(len(pts)):
+        a, r = twin.scores_of(t), ref.scores_of(t)
+        assert np.array_equal(a, r), f"{name}: twin not bitwise at q{t}"
+        p = pal.scores_of(t)
+        np.testing.assert_allclose(p, r, rtol=2e-5, atol=1e-6,
+                                   err_msg=f"{name}: pallas drift at q{t}")
+        if len(p) > 1 and np.std(r) > 0:
+            rho = spearman(p, r)
+            assert rho > 1.0 - 1e-9, f"{name}: pallas rank flip ({rho})"
+    assert ref.counts[-1] == 0, f"{name}: zero-count query not empty"
+    print(f"kernel-smoke {name}: pallas+twin parity OK "
+          f"({int(ref.counts.sum())} scores)")
+
+# XLA-twin serve smoke: the default kernel on CPU serves the analytic
+# twin, warmup reports it, and a batch round-trips.
+model = MF(U, I, K, 1e-3)
+params = model.init_params(jax.random.PRNGKey(0))
+eng = InfluenceEngine(model, params, train, damping=1e-3)
+svc = InfluenceService(engine=eng, config=ServeConfig(max_batch=8,
+                                                      disk_cache=False))
+info = svc.warmup(np.asarray(train.x[:16], np.int64))
+assert info["kernel_variant"] == "xla_analytic", info["kernel_variant"]
+assert info["all_planned_compiled"], "warmup left geometries unarmed"
+reqs = [Request(user=int(u), item=int(i), id=f"q{j}")
+        for j, (u, i) in enumerate(train.x[:24])]
+resp = svc.run(reqs, drain_every=8)
+assert all(r.ok for r in resp), "serve smoke: failed responses"
+print(f"kernel-smoke serve: {len(resp)} requests on the "
+      f"{info['kernel_variant']} twin OK")
+PY
+
+echo "kernel-smoke PASS"

@@ -53,6 +53,14 @@ bash scripts/degraded_smoke.sh || {
   echo "degraded-smoke FAILED (run make degraded-smoke)"
   exit 1
 }
+# Kernel smoke, FATAL: fused score-kernel parity — Pallas (interpret)
+# allclose + rank-exact and the XLA analytic twin BITWISE vs the
+# vmapped-autodiff reference, both geometries, plus an XLA-twin serve
+# round trip (docs/design.md §19).
+bash scripts/kernel_smoke.sh || {
+  echo "kernel-smoke FAILED (run make kernel-smoke)"
+  exit 1
+}
 # Serving smoke next, NON-fatal: the pinned tier-1 verdict below stays
 # exactly the ROADMAP.md pytest command, the smoke just surfaces
 # serving regressions in the same log.

@@ -1,0 +1,374 @@
+"""Fused score-kernel parity suite (docs/design.md §19).
+
+Three interchangeable score-stage variants (influence/kernels/):
+
+  - ``vmap_autodiff`` — the definitional reference,
+  - ``xla_analytic`` — the closed-form XLA twin, pinned BITWISE equal
+    to the reference at engine level (same padded program, same op
+    order on CPU),
+  - ``pallas`` — the fused kernel (interpret mode on CPU), pinned
+    allclose + Spearman-1.0 per query (its in-register accumulation
+    order differs, so bitwise is not the contract).
+
+Coverage: both block geometries (MF and NCF), ragged/padded related
+sets, all-masked rows (zero-count queries and wv = 0 segments), the
+mixed bank-hit/miss merge path, mesh sharding, post-``rebuild_mesh``
+recovery, AOT-key hygiene, and the spectral LiSSA tuning satellite
+(indefinite-block convergence where the static config walks the
+NaN ladder).
+"""
+
+import types
+
+import jax
+import numpy as np
+import pytest
+
+from fia_tpu.data.dataset import RatingDataset
+from fia_tpu.eval.metrics import spearman
+from fia_tpu.influence import factor as fbank
+from fia_tpu.influence import kernels as K
+from fia_tpu.influence import solvers, spectral
+from fia_tpu.influence.engine import InfluenceEngine
+from fia_tpu.influence.grads import autodiff_row_grads
+from fia_tpu.models import MF, NCF
+from fia_tpu.parallel.mesh import make_mesh
+
+U, I, K_EMB = 24, 18, 4
+WD, DAMP = 1e-3, 1e-3
+# rank agreement to float-noise resolution: one adjacent swap in a
+# 20-row related set moves rho by ~1e-3, so this pins Spearman == 1.0
+RHO_ONE = 1.0 - 1e-9
+
+
+def _setup(family="mf", seed=0, n=400):
+    rng = np.random.default_rng(seed)
+    # leave the last user/item id unseen: querying (U-1, I-1) exercises
+    # the zero-count (all-masked) segment on every variant
+    x = np.stack([rng.integers(0, U - 1, n), rng.integers(0, I - 1, n)],
+                 axis=1).astype(np.int32)
+    y = rng.integers(1, 6, n).astype(np.float32)
+    model = (MF(U, I, K_EMB, WD) if family == "mf"
+             else NCF(U, I, K_EMB, WD))
+    params = model.init_params(jax.random.PRNGKey(seed))
+    return model, params, RatingDataset(x, y)
+
+
+def _engine(model, params, train, **kw):
+    # impl stays "auto": with the (default) direct solver and the
+    # models' hooks it resolves to the flat path the kernels live on,
+    # while the lissa/precomputed engines keep their own ladder paths
+    kw.setdefault("damping", DAMP)
+    return InfluenceEngine(model, params, train, **kw)
+
+
+def _points(train, t, seed=7, with_empty=True):
+    rng = np.random.default_rng(seed)
+    pts = train.x[rng.choice(len(train.x), size=t, replace=False)]
+    pts = np.asarray(pts, np.int64)
+    if with_empty:
+        pts = np.concatenate([pts, [[U - 1, I - 1]]])  # count-0 query
+    return pts
+
+
+def _assert_bitwise(res, ref, pts):
+    assert np.array_equal(res.counts, ref.counts)
+    assert np.array_equal(res.ihvp, ref.ihvp)
+    for t in range(len(pts)):
+        assert np.array_equal(res.scores_of(t), ref.scores_of(t))
+
+
+def _assert_close_rank(res, ref, pts):
+    assert np.array_equal(res.counts, ref.counts)
+    for t in range(len(pts)):
+        a, b = res.scores_of(t), ref.scores_of(t)
+        np.testing.assert_allclose(a, b, rtol=2e-5, atol=1e-6)
+        if len(a) > 1 and (np.std(a) > 0 or np.std(b) > 0):
+            assert spearman(a, b) > RHO_ONE
+
+
+class TestResolveVariant:
+    def test_auto_cpu_is_the_analytic_twin(self):
+        model, _, _ = _setup("mf")
+        assert K.resolve_variant("auto", model, backend="cpu") == "xla_analytic"
+        assert K.resolve_variant("auto", model, backend="tpu") == "pallas"
+
+    def test_auto_without_hooks_is_autodiff(self):
+        bare = types.SimpleNamespace(
+            kernel_family=None, kernel_row_inputs=None, block_row_grads=None
+        )
+        assert not K.supports_pallas(bare)
+        assert K.resolve_variant("auto", bare, backend="tpu") == "vmap_autodiff"
+
+    def test_impossible_requests_are_loud(self):
+        bare = types.SimpleNamespace(
+            kernel_family=None, kernel_row_inputs=None, block_row_grads=None
+        )
+        with pytest.raises(ValueError, match="Pallas"):
+            K.resolve_variant("pallas", bare)
+        with pytest.raises(ValueError, match="block_row_grads"):
+            K.resolve_variant("xla_analytic", bare)
+        with pytest.raises(ValueError, match="unknown"):
+            K.resolve_variant("triton", _setup("mf")[0])
+        with pytest.raises(ValueError, match="kernel"):
+            InfluenceEngine(*_setup("mf"), kernel="triton")
+
+    def test_engine_reports_active_variant(self):
+        model, params, train = _setup("mf")
+        assert (_engine(model, params, train).active_kernel_variant()
+                == "xla_analytic")
+        assert (_engine(model, params, train,
+                        kernel="pallas").active_kernel_variant() == "pallas")
+
+
+class TestRowGradParity:
+    """The analytic block_row_grads hook vs the autodiff definition —
+    the (S, d) matrix every non-Pallas variant scores with."""
+
+    @pytest.mark.parametrize("family", ["mf", "ncf"])
+    def test_hook_matches_autodiff(self, family):
+        model, params, train = _setup(family)
+        x = train.x[:64]
+        u, i = int(x[0, 0]), int(x[0, 1])
+        g_hook = model.block_row_grads(params, u, i, x)
+        g_ref = autodiff_row_grads(model, params, u, i, x)
+        np.testing.assert_allclose(np.asarray(g_hook), np.asarray(g_ref),
+                                   rtol=1e-6, atol=1e-7)
+
+
+class TestKernelUnitParity:
+    """fused_scores at the operand level: ragged row counts (S not a
+    sublane multiple — exercises the in-wrapper zero pad), a fully
+    masked segment, and rows whose (u, i) match neither query id."""
+
+    @pytest.mark.parametrize("family", ["mf", "ncf"])
+    @pytest.mark.parametrize("s", [37, 64])
+    def test_variants_agree(self, family, s):
+        model, params, train = _setup(family, seed=3)
+        rng = np.random.default_rng(s)
+        T = 5
+        q = np.stack([rng.integers(0, U - 1, T), rng.integers(0, I - 1, T)],
+                     axis=1).astype(np.int32)
+        t = np.sort(rng.integers(0, T, s)).astype(np.int32)
+        ut, it = q[t, 0], q[t, 1]
+        rel_x = train.x[rng.integers(0, len(train.x), s)].copy()
+        # force owner matches on a prefix so the masks take both values
+        rel_x[: s // 2, 0] = ut[: s // 2]
+        rel_x[s // 3 : s // 2, 1] = it[s // 3 : s // 2]
+        e = rng.standard_normal(s).astype(np.float32)
+        wv = (rng.random(s) < 0.8).astype(np.float32)
+        wv[t == 0] = 0.0  # segment 0: all rows masked
+        d = model.block_size
+        ihvp = rng.standard_normal((T, d)).astype(np.float32)
+        reg_dot = rng.standard_normal(T).astype(np.float32)
+        n_t = np.maximum(np.bincount(t, minlength=T), 1).astype(np.float32)
+
+        args = (model, params, ut, it, t, rel_x, e, wv, ihvp, reg_dot, n_t)
+        ref = np.asarray(K.fused_scores(args[0], "vmap_autodiff", *args[1:]))
+        ana = np.asarray(K.fused_scores(args[0], "xla_analytic", *args[1:]))
+        pal = np.asarray(K.fused_scores(args[0], "pallas", *args[1:]))
+        np.testing.assert_allclose(ana, ref, rtol=1e-6, atol=1e-7)
+        np.testing.assert_allclose(pal, ref, rtol=2e-5, atol=1e-6)
+        assert (pal[wv == 0.0] == 0.0).all()  # masked rows score exactly 0
+
+
+class TestEngineParity:
+    @pytest.mark.parametrize("family", ["mf", "ncf"])
+    def test_xla_twin_bitwise_vs_autodiff(self, family):
+        """Tier 1: the analytic twin IS the reference, bit for bit —
+        same padded program shape, same op order on CPU."""
+        model, params, train = _setup(family)
+        pts = _points(train, 11)
+        res = _engine(model, params, train,
+                      kernel="xla_analytic").query_batch(pts)
+        ref = _engine(model, params, train,
+                      kernel="vmap_autodiff").query_batch(pts)
+        _assert_bitwise(res, ref, pts)
+
+    @pytest.mark.parametrize("family", ["mf", "ncf"])
+    def test_pallas_allclose_and_rank_exact(self, family):
+        """Tier 2: the fused kernel re-associates the dot accumulation,
+        so the pin is allclose + Spearman 1.0 per query."""
+        model, params, train = _setup(family)
+        pts = _points(train, 11)
+        res = _engine(model, params, train, kernel="pallas").query_batch(pts)
+        ref = _engine(model, params, train).query_batch(pts)
+        _assert_close_rank(res, ref, pts)
+        # the count-0 query: no related rows, nothing non-finite
+        assert res.counts[-1] == 0 and len(res.scores_of(len(pts) - 1)) == 0
+        assert np.isfinite(res.ihvp).all()
+
+
+class TestBankMergePath:
+    def test_mixed_hit_miss_merge_per_variant(self, tmp_path):
+        """The precomputed tier's merge stream under each variant: hits
+        score through _bank_fn, misses through the ladder delegate
+        (which inherits the kernel), and the merged batch must match
+        the all-xla engine to kernel tolerance."""
+        model, params, train = _setup("mf")
+        builder = _engine(model, params, train, solver="direct",
+                          cache_dir=str(tmp_path), model_name="tker")
+        pairs = fbank.select_hot_pairs(builder.index, max_entries=12,
+                                       top_users=4, top_items=4)
+        bank = fbank.build_bank(builder, pairs, batch_queries=12)
+        fp = fbank.bank_fingerprint("tker", model.block_size, DAMP,
+                                    *builder._train_host)
+        fbank.publish_bank(bank, builder.factor_bank_path(), fp)
+
+        banked = {tuple(p) for p in bank.pairs.tolist()}
+        miss = np.asarray(
+            [p for p in map(tuple, train.x.tolist()) if p not in banked][:3],
+            np.int64,
+        )
+        hit = np.asarray(bank.pairs[:3], np.int64)
+        mixed = np.concatenate([miss[:1], hit[:2], miss[1:], hit[2:]])
+
+        def run(kernel):
+            eng = _engine(model, params, train, solver="precomputed",
+                          cache_dir=str(tmp_path), model_name="tker",
+                          kernel=kernel)
+            assert eng.ensure_factor_bank() == len(bank)
+            res = eng.query_batch(mixed)
+            st = eng.bank_stats()
+            assert st["hits"] == 3 and st["misses"] == 3
+            return res
+
+        ref = run("xla_analytic")
+        _assert_bitwise(run("vmap_autodiff"), ref, mixed)
+        _assert_close_rank(run("pallas"), ref, mixed)
+
+
+class TestMeshAndRecovery:
+    def test_aot_key_carries_variant(self):
+        model, params, train = _setup("mf")
+        a = _engine(model, params, train)._aot_key(64, 2048)
+        b = _engine(model, params, train, kernel="pallas")._aot_key(64, 2048)
+        assert a != b
+        assert "xla_analytic" in a and "pallas" in b
+        # geometry stays at the warmup-contract positions, mesh fp last
+        assert (a[1], a[2]) == (64, 2048) and a[-1] is None
+
+    @pytest.mark.parametrize("ndev", [2, 4])
+    def test_pallas_sharded_matches_single_device(self, ndev):
+        model, params, train = _setup("mf")
+        pts = _points(train, 9, with_empty=False)
+        ref = _engine(model, params, train).query_batch(pts)
+        eng = _engine(model, params, train, kernel="pallas",
+                      mesh=make_mesh(ndev))
+        _assert_close_rank(eng.query_batch(pts), ref, pts)
+
+    def test_rebuild_mesh_keeps_variant_and_parity(self):
+        """Device-loss recovery: after rebuild_mesh onto a smaller mesh
+        the variant survives, the re-armed geometry serves, and scores
+        still match the single-device reference."""
+        model, params, train = _setup("mf")
+        pts = _points(train, 9, with_empty=False)
+        ref = _engine(model, params, train).query_batch(pts)
+        eng = _engine(model, params, train, kernel="pallas",
+                      mesh=make_mesh(4))
+        geom = eng.flat_geometry(pts)
+        eng.precompile_flat([geom])
+        _assert_close_rank(eng.query_batch(pts), ref, pts)
+
+        eng.rebuild_mesh(make_mesh(2))
+        assert eng.active_kernel_variant() == "pallas"
+        assert not eng._aot  # stale-mesh executables dropped
+        eng.precompile_flat([geom])
+        _assert_close_rank(eng.query_batch(pts), ref, pts)
+
+
+class TestSpectralLissaTuning:
+    """Satellite: spectrum-aware LiSSA tuning on the solver ladder."""
+
+    def _indefinite_block(self):
+        """A REAL indefinite MF block: one train row equal to the query
+        pair with a large residual — the e·C cross term puts ±2|e| eigs
+        on the embedding subspace, swamping the tiny g gᵀ + wd terms."""
+        import jax.numpy as jnp
+
+        model = MF(4, 4, K_EMB, 1e-4)
+        params = model.init_params(jax.random.PRNGKey(1))
+        x = np.asarray([[0, 0], [1, 1], [2, 2]], np.int32)
+        y = np.asarray([5.0, 3.0, 3.0], np.float32)
+        train = RatingDataset(x, y)
+        rel = x[:1]
+        H = np.asarray(
+            model.block_hessian(params, 0, 0, jnp.asarray(rel),
+                                jnp.asarray(y[:1]), jnp.ones((1,)))
+            + DAMP * jnp.eye(model.block_size)
+        )
+        return model, params, train, H
+
+    def test_block_is_indefinite_and_spectral_converges(self):
+        model, params, train, H = self._indefinite_block()
+        eigs = np.linalg.eigvalsh(H)
+        assert eigs[0] < 0  # the premise: a genuinely indefinite block
+
+        hvp = lambda v: H @ v  # noqa: E731
+        lam_max, lam_min = spectral.extreme_eigvals(hvp, H.shape[0])
+        assert float(lam_min) < 0 < float(lam_max)
+        np.testing.assert_allclose(float(lam_max), eigs[-1], rtol=1e-3)
+        np.testing.assert_allclose(float(lam_min), eigs[0], rtol=1e-3)
+
+        scale, shift = spectral.lissa_tuning(hvp, H.shape[0],
+                                             scale_floor=10.0)
+        assert float(shift) > 0
+        v = np.linspace(1.0, 2.0, H.shape[0]).astype(np.float32)
+        got = solvers.solve_lissa(
+            lambda x_: hvp(x_) + shift * x_, v, scale=scale,
+            recursion_depth=2000, auto_scale=False,
+        )
+        want = np.linalg.solve(H + float(shift) * np.eye(H.shape[0]), v)
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-3,
+                                   atol=1e-4)
+        # the static config diverges at ANY scale on this block
+        static = solvers.solve_lissa(hvp, v, scale=float(scale),
+                                     recursion_depth=2000,
+                                     auto_scale=False)
+        assert not np.isfinite(np.asarray(static)).all()
+
+    def test_spectral_engine_keeps_the_lissa_rung(self, capsys):
+        """On the indefinite block the static engine's payload goes
+        non-finite and the NaN ladder escalates it off lissa; the
+        spectral engine serves finite scores and KEEPS the rung."""
+        model, params, train, _ = self._indefinite_block()
+        pts = np.asarray([[0, 0]], np.int64)
+
+        static = _engine(model, params, train, solver="lissa",
+                         lissa_tune="static", lissa_depth=2000)
+        res_s = static.query_batch(pts)
+        assert static.solver != "lissa"  # escalated down the ladder
+        assert np.isfinite(np.asarray(res_s.ihvp)).all()
+
+        spec = _engine(model, params, train, solver="lissa",
+                       lissa_tune="spectral", lissa_depth=2000)
+        res = spec.query_batch(pts)
+        assert spec.solver == "lissa"  # the rung stayed usable
+        assert np.isfinite(np.asarray(res.ihvp)).all()
+        assert np.isfinite(res.scores_of(0)).all()
+
+    def test_spectral_matches_direct_on_pd_blocks(self):
+        """PD blocks: shift ≈ 0 and the tuned recursion solves the same
+        system — rankings match the exact direct solve. Near-zero
+        residuals keep the e·C cross term (the indefiniteness source)
+        small, the serving-time regime of a converged model."""
+        model, params, train = _setup("mf", seed=5)
+        y_fit = np.asarray(model.predict(params, train.x), np.float32)
+        rng = np.random.default_rng(5)
+        train = RatingDataset(
+            train.x, y_fit + 0.1 * rng.standard_normal(len(y_fit))
+            .astype(np.float32)
+        )
+        pts = _points(train, 6, with_empty=False)
+        res = _engine(model, params, train, solver="lissa",
+                      lissa_tune="spectral").query_batch(pts)
+        ref = _engine(model, params, train,
+                      solver="direct").query_batch(pts)
+        for t in range(len(pts)):
+            a, b = res.scores_of(t), ref.scores_of(t)
+            if len(a) > 1 and (np.std(a) > 0 or np.std(b) > 0):
+                assert spearman(a, b) >= 0.999
+
+    def test_ctor_validates_lissa_tune(self):
+        with pytest.raises(ValueError, match="lissa_tune"):
+            InfluenceEngine(*_setup("mf"), lissa_tune="bogus")
