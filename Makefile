@@ -1,7 +1,7 @@
 # Convenience targets. The commands themselves are pinned in
 # ROADMAP.md (tier-1) and scripts/ — these targets just name them.
 
-.PHONY: tier1 test lint lint-io serve-smoke multichip-smoke factor-smoke chaos-smoke chaos-soak churn-smoke degraded-smoke kernel-smoke
+.PHONY: tier1 test lint lint-io serve-smoke multichip-smoke factor-smoke chaos-smoke chaos-soak churn-smoke degraded-smoke kernel-smoke scale-smoke
 
 # The ROADMAP.md tier-1 verify: fast CPU suite, slow tests excluded.
 # Lint is fatal — a finding fails the build before pytest runs.
@@ -71,6 +71,12 @@ kernel-smoke:
 # shed `degraded`, recovery to full). docs/design.md §18.
 degraded-smoke:
 	bash scripts/degraded_smoke.sh
+
+# Scale smoke: row-sharded embedding tables on 8 virtual CPU devices
+# (<180s) — bit-identity vs the replicated engine at the 100k-user
+# tier, per-device table residency shrinking with model_parallel.
+scale-smoke:
+	bash scripts/scale_smoke.sh
 
 # Chaos soak: a seed-range sweep over the FULL fault domain (kill
 # kinds, NaN payloads, deadlines) — the fuzz mode; not part of tier-1.

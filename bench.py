@@ -533,15 +533,43 @@ def main():
             null_host = np.zeros((), np.float32)
             float(null_exe(null_x))  # warm the executable call path
             float(null_jit(null_host))  # compile + warm the jit path
+            # r13 sub-stage split of the old monolithic null floor
+            # (94.75 of 133 ms in BENCH_r05): the dispatch a real batch
+            # pays decomposes into (a) HOST PACKING — the numpy
+            # canonicalize/pad the dispatch path runs before anything
+            # touches the device, timed on the real points array with
+            # the real ops (_dispatch_flat's ascontiguousarray +
+            # int32 cast + trailing-pair pad); (b) TRANSFER — the
+            # host→device put of that packed operand, fenced; (c)
+            # LAUNCH — the AOT executable call on a device-resident
+            # operand (the old null_dispatch_ms). Each is timed in the
+            # SAME interleaved rounds as the stages, min-of-3.
+            pts_host = np.asarray(points, np.int64)
+
+            def _pack_null():
+                a = np.ascontiguousarray(pts_host)
+                a = np.concatenate([a, np.repeat(a[-1:], 8, axis=0)])
+                return a.astype(np.int32)
+
+            packed_null = _pack_null()
+            jax.block_until_ready(jax.device_put(packed_null))  # warm
             best = {st: float("inf") for st in stages}
             null_best = float("inf")
             null_jit_best = float("inf")
+            pack_best = float("inf")
+            xfer_best = float("inf")
             for _ in range(3):
                 null_best = min(null_best, _timed(
                     lambda: float(null_exe(null_x))
                 ))
                 null_jit_best = min(null_jit_best, _timed(
                     lambda: float(null_jit(null_host))
+                ))
+                pack_best = min(pack_best, _timed(_pack_null))
+                xfer_best = min(xfer_best, _timed(
+                    lambda: jax.block_until_ready(
+                        jax.device_put(packed_null)
+                    )
                 ))
                 for st in stages:
                     best[st] = min(best[st], _timed(
@@ -553,6 +581,11 @@ def main():
             device_split["null_jit_dispatch_ms"] = round(
                 null_jit_best * 1e3, 2
             )
+            device_split["null_host_packing_ms"] = round(pack_best * 1e3, 3)
+            device_split["null_transfer_ms"] = round(xfer_best * 1e3, 3)
+            device_split["null_launch_ms"] = device_split[
+                "null_dispatch_ms"
+            ]
             prev = 0.0
             for st in stages:
                 cum = max(best[st], prev)
@@ -1293,6 +1326,195 @@ def multichip_main():
     _maybe_json_out(out)
 
 
+def _hbm_high_water():
+    """Max per-device peak memory (bytes) the backend reports, or None
+    when it reports nothing (CPU: ``memory_stats()`` is None/empty, so
+    the scale sweep carries an explicit estimate field instead)."""
+    import jax
+
+    peaks = []
+    for d in jax.devices():
+        try:
+            st = d.memory_stats()
+        except Exception:  # noqa: BLE001 — stats are best-effort
+            st = None
+        if st and st.get("peak_bytes_in_use"):
+            peaks.append(int(st["peak_bytes_in_use"]))
+    return max(peaks) if peaks else None
+
+
+def scale_sweep_main():
+    """``python bench.py scale_sweep [--quick] [--tiers 100k,1m]
+    [--json_out PATH]`` — the 10M-user table-sharding sweep
+    (docs/design.md §20).
+
+    On CPU hosts run under virtual devices:
+      XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
+          JAX_PLATFORMS=cpu python bench.py scale_sweep --quick
+
+    Two stages, one JSON line:
+
+    - ``bit_identity``: at the 100k-user tier, the row-sharded engine
+      (2-D mesh, ``shard_tables=True``) against the single-device
+      replicated reference at 1/2/4/8 devices — ``np.array_equal`` on
+      scores and iHVPs, the query-axis contract extended to table
+      placement.
+    - ``tiers``: for each scale tier (1m/5m/10m by default), sweep
+      ``model_parallel`` over 1/2/4/8 on the full 8-device mesh and
+      report scores/s, per-device table bytes (must shrink ~linearly
+      with model_parallel), HBM high-water (or a resident-bytes
+      estimate where the backend reports no memory stats), and the
+      steady-state compile count (compilemon: must be 0).
+
+    No training: the sweep times the serving hot path on init params —
+    score *values* are exercised by the bit-identity stage, perf and
+    residency by the tier stage, and neither depends on model quality.
+    """
+    _ensure_live_backend()
+    import jax
+
+    from fia_tpu.data.synthetic import SCALE_TIERS, synthesize_scale
+    from fia_tpu.influence.engine import InfluenceEngine
+    from fia_tpu.models import MF
+    from fia_tpu.parallel.mesh import make_mesh
+    from fia_tpu.parallel.sharded import make_2d_mesh, per_device_table_bytes
+    from fia_tpu.utils import compilemon
+
+    k, wd, damping = 8, 1e-3, 1e-6
+    nq = 8 if QUICK else 32
+    tiers = ("1m",) if QUICK else ("1m", "5m", "10m")
+    if "--tiers" in sys.argv:
+        tiers = tuple(
+            sys.argv[sys.argv.index("--tiers") + 1].split(",")
+        )
+    ndev = jax.device_count()
+    _stage(f"scale sweep: backend={jax.default_backend()} devices={ndev} "
+           f"tiers={','.join(tiers)}")
+
+    def _mk(users, items, rows, seed=0):
+        train = synthesize_scale(users, items, rows, seed=seed)
+        model = MF(users, items, k, wd)
+        params = model.init_params(jax.random.PRNGKey(seed))
+        rng = np.random.default_rng(7)
+        pts = train.x[
+            rng.choice(len(train.x), size=nq, replace=False)
+        ].astype(np.int64)
+        return train, model, params, pts
+
+    # -- stage 1: bit identity at the 100k reference tier
+    users, items, rows = SCALE_TIERS["100k"]
+    train, model, params, pts = _mk(users, items, rows)
+    ref = InfluenceEngine(model, params, train, damping=damping,
+                          solver="direct", impl="flat")
+    base = ref.query_batch(pts)
+    del ref
+    bit_rows = []
+    for d in (1, 2, 4, 8):
+        if d > ndev:
+            break
+        sharded = d > 1  # one device cannot split a table
+        mesh = make_2d_mesh(d, model_parallel=2) if sharded else make_mesh(1)
+        eng = InfluenceEngine(model, params, train, damping=damping,
+                              solver="direct", impl="flat", mesh=mesh,
+                              shard_tables=sharded)
+        got = eng.query_batch(pts, pad_to=base.scores.shape[1])
+        ok = bool(
+            all(np.array_equal(got.scores_of(t), base.scores_of(t))
+                for t in range(len(pts)))
+            and np.array_equal(got.ihvp, base.ihvp)
+        )
+        bit_rows.append({"devices": d, "sharded": sharded,
+                         "bit_identical": ok})
+        _stage(f"bit identity {d}dev sharded={sharded}: "
+               f"{'OK' if ok else 'MISMATCH'}")
+        del eng
+
+    # -- stage 2: scale tiers x model_parallel
+    tier_out = {}
+    for tier in tiers:
+        users, items, rows = SCALE_TIERS[tier]
+        train, model, params, pts = _mk(users, items, rows)
+        full_bytes = sum(
+            int(np.asarray(params[n]).nbytes) for n in ("P", "Q", "bu", "bi")
+        )
+        mp_rows = []
+        for mp in (1, 2, 4, 8):
+            if mp > ndev or ndev % mp:
+                continue
+            try:
+                mesh = (make_mesh(ndev) if mp == 1
+                        else make_2d_mesh(ndev, model_parallel=mp))
+                eng = InfluenceEngine(model, params, train, damping=damping,
+                                      solver="direct", impl="flat",
+                                      mesh=mesh, shard_tables=mp > 1)
+                geom = eng.flat_geometry(pts)
+                aot = eng.precompile_flat([geom])
+                res = eng.query_batch(pts)  # warm the host packing path
+                c1 = compilemon.count()
+                best_dt = float("inf")
+                for _ in range(3):
+                    best_dt = min(best_dt,
+                                  _timed(lambda: eng.query_batch(pts)))
+                pdb = per_device_table_bytes(eng.params, model)
+                row = {
+                    "model_parallel": mp,
+                    "scores_per_sec": round(
+                        int(res.counts.sum()) / best_dt, 1
+                    ),
+                    "per_query_ms": round(best_dt / len(pts) * 1e3, 3),
+                    "per_device_table_bytes": int(pdb),
+                    "table_bytes_vs_replicated": round(
+                        pdb / full_bytes, 4
+                    ),
+                    "hbm_high_water_bytes": _hbm_high_water(),
+                    # honest fallback where the backend reports no
+                    # memory stats (CPU): tables + train tensors
+                    "resident_bytes_est": int(
+                        pdb + train.x.nbytes + train.y.nbytes
+                    ),
+                    "geometry": list(geom),
+                    "aot": aot,
+                    "steady_state_compiles": compilemon.count() - c1,
+                }
+                _stage(
+                    f"tier {tier} mp={mp}: "
+                    f"{row['scores_per_sec']:.0f} scores/s, "
+                    f"{pdb / 2**20:.1f} MiB tables/device "
+                    f"({row['table_bytes_vs_replicated']:.2f}x repl), "
+                    f"{row['steady_state_compiles']} steady compiles"
+                )
+                del eng
+            except Exception as e:  # noqa: BLE001 — keep earlier rows
+                _stage(f"tier {tier} mp={mp} FAILED: {e!r}")
+                row = {"model_parallel": mp, "error": repr(e)}
+            mp_rows.append(row)
+        tier_out[tier] = {
+            "num_users": users, "num_items": items, "num_rows": rows,
+            "replicated_table_bytes": full_bytes,
+            "rows": mp_rows,
+        }
+        del train, model, params
+
+    perfect = [r for t in tier_out.values() for r in t["rows"]
+               if "scores_per_sec" in r]
+    best = max(perfect, key=lambda r: r["scores_per_sec"]) if perfect else None
+    out = {
+        "metric": "fia-influence scale sweep best throughput "
+                  f"(MF k={k}, row-sharded tables)",
+        "value": best["scores_per_sec"] if best else 0.0,
+        "unit": "scores/sec",
+        "details": {
+            "backend": jax.default_backend(),
+            "device_count": ndev,
+            "queries": nq,
+            "bit_identity": bit_rows,
+            "tiers": tier_out,
+        },
+    }
+    print(json.dumps(out))
+    _maybe_json_out(out)
+
+
 def _lint_preflight() -> None:
     """``--lint``: fail fast on lint findings before burning device time.
 
@@ -1329,5 +1551,7 @@ if __name__ == "__main__":
             serve_main()
     elif "multichip" in sys.argv[1:]:
         multichip_main()
+    elif "scale_sweep" in sys.argv[1:]:
+        scale_sweep_main()
     else:
         main()

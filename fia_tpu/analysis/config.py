@@ -57,8 +57,17 @@ DISPATCH_PATH_FUNCTIONS = (
     ("fia_tpu/influence/engine.py", "_dispatch_flat"),
     ("fia_tpu/influence/engine.py", "_finalize_flat"),
     ("fia_tpu/influence/engine.py", "query_many"),
+    ("fia_tpu/influence/engine.py", "_query_bank_hits"),
     ("fia_tpu/serve/service.py", "_dispatch_misses"),
     ("fia_tpu/serve/service.py", "drain"),
+    # The sharded hot path's one sanctioned cross-device fetch: the
+    # masked-gather + psum collective that pulls per-query block rows
+    # out of the row-sharded tables (docs/design.md §20). Registered so
+    # a per-table host transfer or a bare un-placed device_put inside
+    # it is a lint finding, not a silent re-replication.
+    # (shard_model_params is deliberately NOT here: it is a cold-path
+    # placement loop, and its per-leaf put_global is the point.)
+    ("fia_tpu/parallel/sharded.py", "gather_table_rows"),
 )
 
 # Call names FIA204 treats as host→device transfer initiators when they

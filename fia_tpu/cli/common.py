@@ -82,6 +82,13 @@ def base_parser(desc: str) -> argparse.ArgumentParser:
     p.add_argument("--mesh", type=int, default=0,
                    help="shard query batches, training and LOO retraining "
                         "over an N-device 'data' mesh (0 = single device)")
+    p.add_argument("--model_parallel", type=int, default=1,
+                   help="row-shard the embedding tables over a 'model' "
+                        "mesh axis of this size (must divide --mesh; 1 = "
+                        "replicated tables). >1 builds a 2-D "
+                        "('data','model') mesh and turns on the engine's "
+                        "shard_tables placement — for tables too large "
+                        "for one device's HBM (docs/design.md §20)")
     p.add_argument("--log_file", type=str, default="auto",
                    help="JSONL event log path; 'auto' derives one under "
                         "--train_dir, 'none' disables")
@@ -161,12 +168,16 @@ def engine_kwargs(args) -> dict:
         lissa_depth=args.lissa_depth,
         lissa_scale=args.lissa_scale,
         impl=args.impl,
+        shard_tables=getattr(args, "model_parallel", 1) > 1,
     )
 
 
 def mesh_for(args):
-    """A 1-D 'data' Mesh over the first --mesh devices (None when 0)."""
+    """A Mesh over the first --mesh devices (None when 0): 1-D 'data'
+    by default, 2-D ('data','model') when --model_parallel > 1."""
     if not getattr(args, "mesh", 0):
+        if getattr(args, "model_parallel", 1) > 1:
+            raise SystemExit("--model_parallel > 1 requires --mesh N")
         return None
     import jax
     from jax.sharding import Mesh
@@ -178,6 +189,14 @@ def mesh_for(args):
             "are visible (set XLA_FLAGS=--xla_force_host_platform_device_"
             "count=N for a virtual CPU mesh)"
         )
+    mp = int(getattr(args, "model_parallel", 1))
+    if mp > 1:
+        from fia_tpu.parallel.sharded import make_2d_mesh
+
+        try:
+            return make_2d_mesh(args.mesh, model_parallel=mp)
+        except ValueError as e:
+            raise SystemExit(str(e))
     return Mesh(np.asarray(devs[: args.mesh]), ("data",))
 
 

@@ -590,3 +590,45 @@ def synthetic_splits(
         rest_x[num_test : 2 * num_test], rest_y[num_test : 2 * num_test]
     )
     return {"train": train, "validation": valid, "test": test}
+
+
+#: scale-tier geometry for the table-sharding sweep (docs/design.md
+#: §20): name -> (num_users, num_items, num_rows). User-table rows are
+#: the scaling axis; train rows grow sublinearly (the hot path's cost
+#: is per-query related-set work, not the raw row count).
+SCALE_TIERS = {
+    "100k": (100_000, 20_000, 400_000),
+    "1m": (1_000_000, 100_000, 2_000_000),
+    "5m": (5_000_000, 250_000, 4_000_000),
+    "10m": (10_000_000, 500_000, 6_000_000),
+}
+
+
+def synthesize_scale(
+    num_users: int,
+    num_items: int,
+    num_rows: int,
+    seed: int = 0,
+    item_zipf: float = 0.8,
+) -> RatingDataset:
+    """Streaming-cheap generator for the multi-million-user tiers.
+
+    Unlike :func:`synthesize_ratings` there is no planted factor model —
+    an ``(U, rank)`` table at the 10M-user tier would cost more to
+    synthesize than the sweep it feeds. Users are uniform (every user
+    row is equally likely to be resident-relevant, which is exactly the
+    regime row-sharding targets); items follow the Zipf popularity real
+    rating streams show, so popular-item queries carry the large
+    related sets that stress ``s_pad``; ratings are i.i.d. 1-5 stars
+    (score *values* are irrelevant to the perf sweep, and the 100k
+    bit-identity stage only needs determinism, which the seed gives).
+    """
+    rng = np.random.default_rng(seed)
+    users = rng.integers(0, num_users, size=num_rows)
+    w = 1.0 / np.arange(1, num_items + 1) ** item_zipf
+    w /= w.sum()
+    perm = rng.permutation(num_items)  # decouple popularity from id order
+    items = perm[rng.choice(num_items, size=num_rows, p=w)]
+    y = rng.integers(1, 6, size=num_rows).astype(np.float32)
+    x = np.stack([users, items], axis=1).astype(np.int32)
+    return RatingDataset(x, y)

@@ -235,7 +235,22 @@ class InfluenceEngine:
         if kernel not in ("auto",) + K.VARIANTS:
             raise ValueError(f"unknown kernel {kernel!r}")
         self.kernel = kernel
-        self._kernel_variant = K.resolve_variant(kernel, model)
+        # Row-sharded tables never meet the Pallas kernel: its score
+        # stage re-fetches embedding rows from fully-resident tables
+        # inside the kernel (kernels/common.onehot_fetch) — exactly the
+        # any-device-reads-any-row pattern sharding removes. 'auto'
+        # therefore resolves as on a non-TPU backend (the XLA analytic
+        # twin, whose score stage consumes the pre-gathered g), and an
+        # explicit 'pallas' request is rejected loudly rather than
+        # silently served from unsharded tables.
+        if shard_tables and kernel == "pallas":
+            raise ValueError(
+                "kernel='pallas' is incompatible with shard_tables: the "
+                "fused kernel re-fetches rows from fully-resident tables"
+            )
+        self._kernel_variant = K.resolve_variant(
+            kernel, model, backend="cpu" if shard_tables else None
+        )
         # LiSSA tuning on the solver-ladder miss path: 'spectral' runs
         # extreme_eigvals on the block HVP and derives (scale, shift)
         # covering BOTH spectrum ends — indefinite blocks (λ_min < 0,
@@ -433,10 +448,17 @@ class InfluenceEngine:
         inject.fire(sites.ENGINE_UPLOAD)
         mesh = self.mesh
         self.params = jax.tree_util.tree_map(jnp.asarray, self._params_host)
-        if self._shard_tables:
+        if self._sharded_now():
             from fia_tpu.parallel.sharded import shard_model_params
 
-            self.params = shard_model_params(mesh, self.params, self.model)
+            # pad_rows: the flat hot path gathers block rows through a
+            # shard_map collective, which needs row counts divisible by
+            # the 'model' axis; the zero pad rows are unreachable by
+            # real ids and exactly neutral to predictions, regularizer
+            # sums, and the per-leaf sum/norm params fingerprint.
+            self.params = shard_model_params(
+                mesh, self.params, self.model, pad_rows=True
+            )
         self.train_x = jnp.asarray(self._train_host[0])
         self.train_y = jnp.asarray(self._train_host[1])
         self._postings = tuple(
@@ -468,6 +490,21 @@ class InfluenceEngine:
             )
             if self._rowfeat is not None:
                 self._rowfeat = put_global(mesh, self._rowfeat, P())
+
+    def _sharded_now(self) -> bool:
+        """Tables row-sharded on the CURRENT mesh. A ``shard_tables``
+        engine re-homed by :meth:`rebuild_mesh` onto a mesh without a
+        non-trivial 'model' axis (``surviving_mesh`` collapses to
+        trailing-axis 1 when survivors can't fill a model group, and
+        ``None`` is the single-device last rung) degrades to replicated
+        placement — the tables must then fit one device, which degraded
+        mode accepts over dying."""
+        return (
+            self._shard_tables
+            and self.mesh is not None
+            and "model" in self.mesh.axis_names
+            and int(self.mesh.shape["model"]) > 1
+        )
 
     def _want_row_features(self) -> bool:
         if (
@@ -559,10 +596,7 @@ class InfluenceEngine:
             deadline=rpolicy.Deadline(max_wait_s),
         )
         if self._bank is not None:
-            self._bank_device = (
-                jnp.asarray(self._bank.factor),
-                jnp.asarray(self._bank.kind.astype(np.int32)),
-            )
+            self._place_bank()
         if self._bank_delegate is not None:
             self._bank_delegate.rebuild_mesh(mesh, max_wait_s=max_wait_s)
 
@@ -661,6 +695,63 @@ class InfluenceEngine:
         return self._jitted[pad]
 
     # -- flat segment-sum query path --------------------------------------
+    @staticmethod
+    def _flat_prelude(s_pad: int):
+        """The flat program's integer prelude, shared by ``_flat_fn``,
+        ``_bank_fn``, and the sharded out_fn's rel-id recomputation (all
+        three must produce the IDENTICAL row layout — integer ops, so
+        sharing the code makes that exact by construction). Maps a
+        ``(T, 2)`` query block + CSR postings to per-flat-position
+        ``(u, i, counts, t, row, wv, ut, it)``: segment ids ``t``, the
+        owning train-row index ``row``, validity weights ``wv``, and
+        the per-row owning-query ids ``ut``/``it``."""
+
+        def prelude(tx, postings):
+            T = tx.shape[0]
+            u, i = tx[:, 0], tx[:, 1]
+            uoff, urows, ioff, irows = postings
+            nu = uoff[u + 1] - uoff[u]
+            ni = ioff[i + 1] - ioff[i]
+            counts = nu + ni
+            off = jnp.concatenate(
+                [jnp.zeros((1,), jnp.int32),
+                 jnp.cumsum(counts, dtype=jnp.int32)]
+            )
+            total = off[-1]
+
+            s = jnp.arange(s_pad, dtype=jnp.int32)
+            # segment ids by scatter+cumsum, not searchsorted: the
+            # binary search lowers to ~log2(T) serialized S-wide gather
+            # rounds, the scan to one T-element scatter + one VPU
+            # cumsum. Duplicate offsets (empty segments) accumulate in
+            # the scatter and the cumsum skips them correctly.
+            t = jnp.clip(
+                jnp.cumsum(
+                    jnp.zeros((s_pad,), jnp.int32)
+                    .at[off[1:T]]
+                    .add(1, mode="drop")
+                ),
+                0, T - 1,
+            )
+            pos = s - off[t]
+            valid = s < total
+            ut, it = u[t], i[t]
+            # ONE flat-row gather from the concatenated postings (item
+            # lists offset past the user lists) instead of gathering
+            # both lists and selecting — halves the dominant random-
+            # access traffic of the row construction
+            cat_rows = jnp.concatenate([urows, irows])
+            base = jnp.where(
+                pos < nu[t],
+                uoff[ut] + pos,
+                urows.shape[0] + ioff[it] + pos - nu[t],
+            )
+            row = cat_rows[jnp.clip(base, 0, cat_rows.shape[0] - 1)]
+            wv = valid.astype(jnp.float32)
+            return u, i, counts, t, row, wv, ut, it
+
+        return prelude
+
     def _flat_fn(self, s_pad: int, stage: str = "scores",
                  donate: bool = False):
         """All queries' related rows concatenated into one flat (S,)
@@ -694,13 +785,15 @@ class InfluenceEngine:
         """
         use_feat = self._rowfeat is not None
         variant = self._kernel_variant
-        key = ("flat", s_pad, stage, use_feat, donate, variant)
+        sharded = self._sharded_now()
+        key = ("flat", s_pad, stage, use_feat, donate, variant, sharded)
         if key in self._jitted:
             return self._jitted[key]
         if stage not in ("grads", "hessian", "solve", "scores"):
             raise ValueError(f"unknown stage {stage!r}")
         model = self.model
         mesh = self.mesh
+        prelude = self._flat_prelude(s_pad)
         d = model.block_size
         # chunk must divide S; flat_chunk is a power of two and S a
         # multiple of the bucket floor, so the gcd is their largest
@@ -711,47 +804,10 @@ class InfluenceEngine:
 
         chunk = math.gcd(s_pad, self.flat_chunk)
 
-        def fn(params, train_x, train_y, postings, tx, rowfeat):
+        def fn(params, train_x, train_y, postings, tx, rowfeat,
+               grel=None, gqry=None):
             T = tx.shape[0]
-            u, i = tx[:, 0], tx[:, 1]
-            uoff, urows, ioff, irows = postings
-            nu = uoff[u + 1] - uoff[u]
-            ni = ioff[i + 1] - ioff[i]
-            counts = nu + ni
-            off = jnp.concatenate(
-                [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts, dtype=jnp.int32)]
-            )
-            total = off[-1]
-
-            s = jnp.arange(s_pad, dtype=jnp.int32)
-            # segment ids by scatter+cumsum, not searchsorted: the
-            # binary search lowers to ~log2(T) serialized S-wide gather
-            # rounds, the scan to one T-element scatter + one VPU
-            # cumsum. Duplicate offsets (empty segments) accumulate in
-            # the scatter and the cumsum skips them correctly.
-            t = jnp.clip(
-                jnp.cumsum(
-                    jnp.zeros((s_pad,), jnp.int32)
-                    .at[off[1:T]]
-                    .add(1, mode="drop")
-                ),
-                0, T - 1,
-            )
-            pos = s - off[t]
-            valid = s < total
-            ut, it = u[t], i[t]
-            # ONE flat-row gather from the concatenated postings (item
-            # lists offset past the user lists) instead of gathering
-            # both lists and selecting — halves the dominant random-
-            # access traffic of the row construction
-            cat_rows = jnp.concatenate([urows, irows])
-            base = jnp.where(
-                pos < nu[t],
-                uoff[ut] + pos,
-                urows.shape[0] + ioff[it] + pos - nu[t],
-            )
-            row = cat_rows[jnp.clip(base, 0, cat_rows.shape[0] - 1)]
-            wv = valid.astype(jnp.float32)
+            u, i, counts, t, row, wv, ut, it = prelude(tx, postings)
 
             # Per-flat-row prediction gradients w.r.t. the owning
             # query's block (the J of the Gauss-Newton form), residual
@@ -776,6 +832,19 @@ class InfluenceEngine:
                 g, e, ma, mb = model.grads_from_row_features(feat, ut, it)
                 ab = wv * ma * mb
                 rel_x = train_x[row] if variant == "pallas" else None
+            elif grel is not None:
+                # row-sharded tables: the per-row block gradients and
+                # residuals come from rows the dispatching out_fn
+                # gathered ONCE via the 'model'-axis collective
+                # (parallel/sharded.gather_table_rows); the hook is
+                # op-for-op the row_grads + predict pair below, so the
+                # sharded program stays bitwise the replicated one.
+                rel_x = train_x[row]
+                rel_y = train_y[row]
+                g, e = model.grads_from_rows(
+                    params, grel, rel_x, rel_y, ut, it
+                )
+                ab = wv * (rel_x[:, 0] == ut) * (rel_x[:, 1] == it)
             else:
                 rel_x = train_x[row]
                 rel_y = train_y[row]
@@ -854,22 +923,52 @@ class InfluenceEngine:
             if stage == "hessian":
                 return H
 
-            v = jax.vmap(
-                lambda uu, ii, xj: G.block_prediction_grad(
-                    model, params, uu, ii, xj[None, :]
-                )
-            )(u, i, tx)
+            if gqry is not None:
+                # Row-sharded query-side math: per-query "mini params"
+                # substitute each table with its single gathered row
+                # (leading axis 1), so the IDENTICAL autodiff /
+                # extract_block graphs run at (u, i) = (0, 0) on row 0
+                # — the jnp.where masks in block_predict select the
+                # block branch in both programs (the test point IS the
+                # query pair), so v and θ are bitwise the replicated
+                # path's.
+                zx = jnp.zeros((1, 2), jnp.int32)
+
+                def mini(qr):
+                    return {
+                        kk: (qr[kk][None] if kk in qr else vv)
+                        for kk, vv in params.items()
+                    }
+
+                v = jax.vmap(
+                    lambda qr: G.block_prediction_grad(
+                        model, mini(qr), 0, 0, zx
+                    )
+                )(gqry)
+            else:
+                v = jax.vmap(
+                    lambda uu, ii, xj: G.block_prediction_grad(
+                        model, params, uu, ii, xj[None, :]
+                    )
+                )(u, i, tx)
             ihvp = jax.vmap(solvers.solve_direct)(H, v)
             if stage == "solve":
                 return ihvp, v
 
             # score_s = ∇_block L(z_s) · ihvp_t / n_t, with the per-example
             # loss gradient 2 e g + wd·θ̃ (θ̃ = decayed block dims)
-            theta = jax.vmap(
-                lambda uu, ii: model.flatten_block(
-                    model.extract_block(params, uu, ii)
-                )
-            )(u, i)
+            if gqry is not None:
+                theta = jax.vmap(
+                    lambda qr: model.flatten_block(
+                        model.extract_block(mini(qr), 0, 0)
+                    )
+                )(gqry)
+            else:
+                theta = jax.vmap(
+                    lambda uu, ii: model.flatten_block(
+                        model.extract_block(params, uu, ii)
+                    )
+                )(u, i)
             reg_dot = jnp.sum(theta * rdiag[None] * ihvp, axis=1)  # (T,)
             scores = K.fused_scores(
                 model, variant, params, ut, it, t, rel_x, e, wv,
@@ -879,6 +978,48 @@ class InfluenceEngine:
 
         if mesh is None:
             out_fn = fn
+        elif sharded:
+            from fia_tpu.parallel import sharded as SH
+
+            def out_fn(params, train_x, train_y, postings, txs, rowfeat):
+                # Same (ndev, t_loc, 2) query-shard layout as the
+                # replicated branch below — but the tables live
+                # row-sharded over 'model', so the block rows every
+                # per-query op needs are fetched FIRST: the flat rel
+                # ids are recomputed per shard with the SAME integer
+                # prelude the body runs (exact by construction), then
+                # two gather_table_rows collectives (rel rows on the
+                # s_pad axis, query rows on the t_loc axis) move
+                # exactly the needed rows onto each query's data
+                # shard. Everything downstream is shard-local — the
+                # only hot-path collectives are the two gathers
+                # (docs/design.md §20).
+                txs = jax.lax.with_sharding_constraint(
+                    txs, NamedSharding(mesh, P("data", None, None))
+                )
+                rel = jax.vmap(
+                    # prelude()[4] is the flat train-row index
+                    lambda t: train_x[prelude(t, postings)[4]]
+                )(txs)
+                grel = SH.gather_table_rows(
+                    mesh, model, params, rel[..., 0], rel[..., 1]
+                )
+                gqry = SH.gather_table_rows(
+                    mesh, model, params, txs[..., 0], txs[..., 1]
+                )
+                out = jax.vmap(
+                    lambda t, gr, gq: fn(params, train_x, train_y,
+                                         postings, t, rowfeat,
+                                         grel=gr, gqry=gq)
+                )(txs, grel, gqry)
+                return jax.tree_util.tree_map(
+                    lambda a: jax.lax.with_sharding_constraint(
+                        a, NamedSharding(
+                            mesh, P("data", *([None] * (a.ndim - 1)))
+                        )
+                    ),
+                    out,
+                )
         else:
             def out_fn(params, train_x, train_y, postings, txs, rowfeat):
                 # (ndev, t_loc, 2) query shards placed along 'data' by
@@ -931,6 +1072,10 @@ class InfluenceEngine:
             and self.pad_policy == "batch"
             and self.model.block_cross_const is not None
             and self.model.block_reg_diag is not None
+            # row-sharded tables additionally need the pre-gathered-row
+            # gradient hook (the sharded body never indexes a table)
+            and (not self._sharded_now()
+                 or self.model.grads_from_rows is not None)
         )
 
     def _query_pad(self, T: int) -> int:
@@ -1024,10 +1169,12 @@ class InfluenceEngine:
         # mesh fingerprint LAST: warmup/compiled_geometries index the
         # geometry as (k[1], k[2]) — appending keeps those stable; the
         # kernel variant sits before it so a variant flip (e.g. a
-        # post-recovery CPU rebuild) can never serve a stale executable
+        # post-recovery CPU rebuild) can never serve a stale executable,
+        # and the table-placement flag likewise (rebuild_mesh can flip
+        # a shard_tables engine between sharded and replicated programs)
         return ("flat", t_pad, s_pad, self._rowfeat is not None,
                 self._donate_scratch(), self._kernel_variant,
-                self._mesh_fp())
+                self._sharded_now(), self._mesh_fp())
 
     def precompile_flat(self, geometries) -> dict:
         """AOT pre-lower + compile flat programs for ``(t_pad, s_pad)``
@@ -1049,20 +1196,34 @@ class InfluenceEngine:
                 cached.append([t_pad, s_pad])
                 continue
             fn = self._flat_fn(s_pad, donate=self._donate_scratch())
+            params_in = self.params
             if self.mesh is not None:
-                # lower WITH the dispatch-time input sharding: the AOT
+                # lower WITH the dispatch-time input shardings: the AOT
                 # executable is strict about operand placement, and
                 # baking the NamedSharding in keeps steady state
-                # zero-compile on any device count (compilemon-pinned)
+                # zero-compile on any device count (compilemon-pinned).
+                # Row-sharded tables (shard_tables) lower as sharded
+                # ShapeDtypeStructs carrying each resident leaf's
+                # NamedSharding — the lowering never touches the real
+                # buffers; un-placed leaves (the replicated-mesh case,
+                # whose params live uncommitted on one device) lower as
+                # plain specs and keep jit's free placement.
                 ndev = int(self.mesh.shape["data"])
                 tx = jax.ShapeDtypeStruct(
                     (ndev, t_pad, 2), jnp.int32,
                     sharding=NamedSharding(self.mesh, P("data", None, None)),
                 )
+                if self._sharded_now():
+                    params_in = jax.tree_util.tree_map(
+                        lambda a: jax.ShapeDtypeStruct(
+                            a.shape, a.dtype, sharding=a.sharding
+                        ),
+                        self.params,
+                    )
             else:
                 tx = jax.ShapeDtypeStruct((t_pad, 2), jnp.int32)
             self._aot[key] = fn.lower(
-                self.params, self.train_x, self.train_y, self._postings,
+                params_in, self.train_x, self.train_y, self._postings,
                 tx, self._rowfeat,
             ).compile()
             compiled.append([t_pad, s_pad])
@@ -1673,11 +1834,32 @@ class InfluenceEngine:
             return 0
         self._bank = bank
         self._bank_lookup = bank.lookup()
-        self._bank_device = (
-            jnp.asarray(bank.factor),
-            jnp.asarray(bank.kind.astype(np.int32)),
-        )
+        self._place_bank()
         return len(bank)
+
+    def _place_bank(self) -> None:
+        """(Re)place the loaded bank device-resident.
+
+        Under a mesh the factor/kind arrays are *explicitly replicated*
+        with the mesh's own placement (``put_global``) rather than a
+        bare ``jnp.asarray`` (which lands them on device 0 only):
+        placement-aware residency — every device owns the full bank, so
+        a bank hit on any query shard reads local factors and never
+        cross-fetches state another device owns. With row-sharded
+        tables the same applies to the block rows the hit program
+        needs: those arrive through the dispatch's gather collective,
+        never by reaching into another shard's table."""
+        if self._bank is None:
+            self._bank_device = None
+            return
+        fac = jnp.asarray(self._bank.factor)
+        knd = jnp.asarray(self._bank.kind.astype(np.int32))
+        if self.mesh is not None:
+            from fia_tpu.parallel.distributed import put_global
+
+            fac = put_global(self.mesh, fac, P())
+            knd = put_global(self.mesh, knd, P())
+        self._bank_device = (fac, knd)
 
     def ensure_factor_bank(self) -> int:
         """Load the bank once, lazily; returns servable entry count."""
@@ -1753,17 +1935,23 @@ class InfluenceEngine:
 
     def _bank_serving_eligible(self) -> bool:
         # the bank hit program is the flat prelude + a bank gather: it
-        # needs the same GN hooks and single-device geometry the flat
-        # path needs (meshes would shard a bank every device already
-        # holds — not worth a second dispatch layout)
+        # needs the same GN hooks the flat path needs. Single-process
+        # meshes serve hits too (r13): the bank replicates per device
+        # (_place_bank) and the hit program query-shards exactly like
+        # _dispatch_flat, with the same sharded-table gather when the
+        # tables are row-sharded. Multi-host keeps the delegate route
+        # (the allgather layout of a second program family isn't worth
+        # the hit-rate at pod scale yet).
         return (
-            self.mesh is None
+            not self._multihost
             and self._bank_device is not None
             and self.hessian_mode != "autodiff"
             and not self.group_queries
             and self.pad_policy == "batch"
             and self.model.block_cross_const is not None
             and self.model.block_reg_diag is not None
+            and (not self._sharded_now()
+                 or self.model.grads_from_rows is not None)
         )
 
     def _bank_fn(self, s_pad: int):
@@ -1777,50 +1965,32 @@ class InfluenceEngine:
         assembly/corruption seams downstream."""
         use_feat = self._rowfeat is not None
         variant = self._kernel_variant
-        key = ("flatbank", s_pad, use_feat, variant)
+        sharded = self._sharded_now()
+        key = ("flatbank", s_pad, use_feat, variant, sharded)
         if key in self._jitted:
             return self._jitted[key]
         from jax.scipy.linalg import cho_solve
 
         model = self.model
+        mesh = self.mesh
+        prelude = self._flat_prelude(s_pad)
 
         def fn(params, train_x, train_y, postings, tx, rowfeat,
-               bfac, bknd, bidx):
-            T = tx.shape[0]
-            u, i = tx[:, 0], tx[:, 1]
-            uoff, urows, ioff, irows = postings
-            nu = uoff[u + 1] - uoff[u]
-            ni = ioff[i + 1] - ioff[i]
-            counts = nu + ni
-            off = jnp.concatenate(
-                [jnp.zeros((1,), jnp.int32),
-                 jnp.cumsum(counts, dtype=jnp.int32)]
-            )
-            total = off[-1]
-            s = jnp.arange(s_pad, dtype=jnp.int32)
-            t = jnp.clip(
-                jnp.cumsum(
-                    jnp.zeros((s_pad,), jnp.int32)
-                    .at[off[1:T]]
-                    .add(1, mode="drop")
-                ),
-                0, T - 1,
-            )
-            pos = s - off[t]
-            valid = s < total
-            ut, it = u[t], i[t]
-            cat_rows = jnp.concatenate([urows, irows])
-            base = jnp.where(
-                pos < nu[t],
-                uoff[ut] + pos,
-                urows.shape[0] + ioff[it] + pos - nu[t],
-            )
-            row = cat_rows[jnp.clip(base, 0, cat_rows.shape[0] - 1)]
-            wv = valid.astype(jnp.float32)
+               bfac, bknd, bidx, grel=None, gqry=None):
+            u, i, counts, t, row, wv, ut, it = prelude(tx, postings)
             if use_feat:
                 feat = rowfeat[row]
                 g, e, _, _ = model.grads_from_row_features(feat, ut, it)
                 rel_x = train_x[row] if variant == "pallas" else None
+            elif grel is not None:
+                # row-sharded tables: rows pre-gathered by the
+                # dispatching out_fn (see _flat_fn) — op-for-op the
+                # row_grads + predict pair below
+                rel_x = train_x[row]
+                rel_y = train_y[row]
+                g, e = model.grads_from_rows(
+                    params, grel, rel_x, rel_y, ut, it
+                )
             else:
                 rel_x = train_x[row]
                 rel_y = train_y[row]
@@ -1833,11 +2003,28 @@ class InfluenceEngine:
                 )
                 e = model.predict(params, rel_x) - rel_y
 
-            v = jax.vmap(
-                lambda uu, ii, xj: G.block_prediction_grad(
-                    model, params, uu, ii, xj[None, :]
-                )
-            )(u, i, tx)
+            if gqry is not None:
+                # per-query mini params from gathered rows (see
+                # _flat_fn's sharded branch — bitwise the same graphs)
+                zx = jnp.zeros((1, 2), jnp.int32)
+
+                def mini(qr):
+                    return {
+                        kk: (qr[kk][None] if kk in qr else vv)
+                        for kk, vv in params.items()
+                    }
+
+                v = jax.vmap(
+                    lambda qr: G.block_prediction_grad(
+                        model, mini(qr), 0, 0, zx
+                    )
+                )(gqry)
+            else:
+                v = jax.vmap(
+                    lambda uu, ii, xj: G.block_prediction_grad(
+                        model, params, uu, ii, xj[None, :]
+                    )
+                )(u, i, tx)
             Fsel = bfac[bidx]  # (T, d, d): L or H^-1 per entry kind
             ksel = bknd[bidx]
             chol = jax.vmap(
@@ -1848,11 +2035,18 @@ class InfluenceEngine:
 
             n_t = jnp.maximum(counts.astype(jnp.float32), 1.0)
             rdiag = model.block_reg_diag(params)
-            theta = jax.vmap(
-                lambda uu, ii: model.flatten_block(
-                    model.extract_block(params, uu, ii)
-                )
-            )(u, i)
+            if gqry is not None:
+                theta = jax.vmap(
+                    lambda qr: model.flatten_block(
+                        model.extract_block(mini(qr), 0, 0)
+                    )
+                )(gqry)
+            else:
+                theta = jax.vmap(
+                    lambda uu, ii: model.flatten_block(
+                        model.extract_block(params, uu, ii)
+                    )
+                )(u, i)
             reg_dot = jnp.sum(theta * rdiag[None] * ihvp, axis=1)
             scores = K.fused_scores(
                 model, variant, params, ut, it, t, rel_x, e, wv,
@@ -1860,7 +2054,58 @@ class InfluenceEngine:
             )
             return scores, ihvp, v
 
-        self._jitted[key] = jax.jit(fn)
+        if mesh is None:
+            out_fn = fn
+        else:
+            def out_fn(params, train_x, train_y, postings, txs, rowfeat,
+                       bfac, bknd, bidxs):
+                # (ndev, t_loc, 2) query shards + (ndev, t_loc) bank
+                # rows along 'data' (packed by _query_bank_hits, same
+                # layout as _dispatch_flat); the bank itself is
+                # replicated per device (_place_bank), so Fsel gathers
+                # are shard-local. With row-sharded tables the block
+                # rows arrive by the same two gather collectives as
+                # the flat program.
+                txs = jax.lax.with_sharding_constraint(
+                    txs, NamedSharding(mesh, P("data", None, None))
+                )
+                bidxs = jax.lax.with_sharding_constraint(
+                    bidxs, NamedSharding(mesh, P("data", None))
+                )
+                if sharded:
+                    from fia_tpu.parallel import sharded as SH
+
+                    rel = jax.vmap(
+                        lambda t: train_x[prelude(t, postings)[4]]
+                    )(txs)
+                    grel = SH.gather_table_rows(
+                        mesh, model, params, rel[..., 0], rel[..., 1]
+                    )
+                    gqry = SH.gather_table_rows(
+                        mesh, model, params, txs[..., 0], txs[..., 1]
+                    )
+                    out = jax.vmap(
+                        lambda t, b, gr, gq: fn(
+                            params, train_x, train_y, postings, t,
+                            rowfeat, bfac, bknd, b, grel=gr, gqry=gq,
+                        )
+                    )(txs, bidxs, grel, gqry)
+                else:
+                    out = jax.vmap(
+                        lambda t, b: fn(params, train_x, train_y,
+                                        postings, t, rowfeat, bfac,
+                                        bknd, b)
+                    )(txs, bidxs)
+                return jax.tree_util.tree_map(
+                    lambda a: jax.lax.with_sharding_constraint(
+                        a, NamedSharding(
+                            mesh, P("data", *([None] * (a.ndim - 1)))
+                        )
+                    ),
+                    out,
+                )
+
+        self._jitted[key] = jax.jit(out_fn)
         return self._jitted[key]
 
     def _query_bank_hits(self, points: np.ndarray, rows: np.ndarray,
@@ -1875,6 +2120,49 @@ class InfluenceEngine:
             ridx = np.asarray(rows, np.int64)
             T = tx_np.shape[0]
             t_pad = self._query_pad(T)
+            bfac, bknd = self._bank_device
+            pad = bucketed_pad(
+                counts.max() if counts.size else 1, self.pad_bucket, pad_to
+            )
+            if self.mesh is not None:
+                # same (ndev, t_loc, 2) shard packing as _dispatch_flat,
+                # plus the parallel (ndev, t_loc) bank-row ids (the
+                # factor bank itself is replicated per device by
+                # _place_bank, so each shard gathers its own factors)
+                ndev, q, t_loc, s_loc = self._mesh_plan(counts, T)
+                sh = np.empty((ndev, t_loc, 2), np.int64)
+                sb = np.empty((ndev, t_loc), np.int64)
+                for k in range(ndev):
+                    rows_k = tx_np[k * q: (k + 1) * q]
+                    ridx_k = ridx[k * q: (k + 1) * q]
+                    if rows_k.shape[0] == 0:
+                        rows_k = tx_np[-1:]
+                        ridx_k = ridx[-1:]
+                    if rows_k.shape[0] < t_loc:
+                        n = t_loc - rows_k.shape[0]
+                        rows_k = np.concatenate(
+                            [rows_k, np.repeat(rows_k[-1:], n, axis=0)]
+                        )
+                        ridx_k = np.concatenate(
+                            [ridx_k, np.repeat(ridx_k[-1:], n)]
+                        )
+                    sh[k] = rows_k
+                    sb[k] = ridx_k
+                from fia_tpu.parallel.distributed import put_global
+
+                tx = put_global(
+                    self.mesh, sh.astype(np.int32), P("data", None, None)
+                )
+                bx = put_global(
+                    self.mesh, sb.astype(np.int32), P("data", None)
+                )
+                out = self._bank_fn(s_loc)(
+                    self.params, self.train_x, self.train_y,
+                    self._postings, tx, self._rowfeat, bfac, bknd, bx,
+                )
+                return self._assemble_packed(
+                    points, counts, out, pad, shards=(ndev, q, t_loc)
+                )
             if t_pad > T:
                 # same trailing-pair duplication as _dispatch_flat: pad
                 # queries' flat rows land past `total` and slice away
@@ -1885,14 +2173,10 @@ class InfluenceEngine:
                     [ridx, np.repeat(ridx[-1:], t_pad - T)]
                 )
             s_pad = self._s_pad_for(int(counts.sum()))
-            bfac, bknd = self._bank_device
             out = self._bank_fn(s_pad)(
                 self.params, self.train_x, self.train_y, self._postings,
                 jnp.asarray(tx_np, jnp.int32), self._rowfeat,
                 bfac, bknd, jnp.asarray(ridx, jnp.int32),
-            )
-            pad = bucketed_pad(
-                counts.max() if counts.size else 1, self.pad_bucket, pad_to
             )
             return self._assemble_packed(points, counts, out, pad)
         except Exception as e:

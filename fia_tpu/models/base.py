@@ -207,6 +207,17 @@ class LatentFactorModel:
     #: user/item match indicators, ARE the per-row block gradients.
     block_row_grads = None
 
+    #: optional table-row gradient hook for the row-sharded flat path
+    #: (``parallel/sharded.py``): ``grads_from_rows(params, rows, x, y,
+    #: u, i) -> (g (B, d), e (B,))`` computes the per-row block
+    #: gradients and residuals from *pre-gathered* table rows — ``rows``
+    #: maps each of the model's ``TABLE_PARAMS`` entries to its values
+    #: at the B flat rows' own (user, item) ids — instead of indexing
+    #: the tables directly. Must be op-for-op the ``block_row_grads`` +
+    #: ``predict`` pair so the sharded program (which fetches the rows
+    #: once via collective) is bit-identical to the replicated one.
+    grads_from_rows = None
+
     #: optional fused-score-kernel hooks (influence/kernels/): a model
     #: whose ``block_row_grads`` is closed-form over its own gathered
     #: embedding rows can let the Pallas score kernel re-form the

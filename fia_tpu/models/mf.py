@@ -176,6 +176,27 @@ class MF(LatentFactorModel):
             axis=1,
         )
 
+    def grads_from_rows(self, params, rows, x, y, u, i):
+        """(g, e) from pre-gathered table rows (see base hook doc):
+        op-for-op ``block_row_grads`` + ``predict`` with every table
+        index replaced by the corresponding gathered row, so the
+        row-sharded flat path reproduces the replicated one bitwise."""
+        xu, xi = x[:, 0], x[:, 1]
+        a = (xu == u).astype(jnp.float32)
+        b = (xi == i).astype(jnp.float32)
+        g = jnp.concatenate(
+            [
+                a[:, None] * rows["Q"],
+                b[:, None] * rows["P"],
+                a[:, None],
+                b[:, None],
+            ],
+            axis=1,
+        )
+        dot = jnp.sum(rows["P"] * rows["Q"], axis=-1)
+        pred = dot + rows["bu"] + rows["bi"] + params["bg"]
+        return g, pred - y
+
     # -- fused score-kernel hooks (see base doc + influence/kernels/mf.py):
     # the kernel re-forms g_j = [a Q[i_j]; b P[u_j]; a; b] in VMEM from
     # the raw rows, so the gather ships them in that order.

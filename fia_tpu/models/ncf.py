@@ -139,6 +139,13 @@ class NCF(LatentFactorModel):
         block_row_grads and build_row_features route through it."""
         own = (params["P_mlp"][xu], params["Q_mlp"][xi],
                params["P_gmf"][xu], params["Q_gmf"][xi])
+        return self._own_grads_from_rows(params, own)
+
+    def _own_grads_from_rows(self, params, own):
+        """The batched backward of :meth:`_own_grads` over
+        already-gathered rows ``own = (pm, qm, pg, qg)`` — split out so
+        the row-sharded path (rows arrive via collective gather) runs
+        the identical gradient graph at the same batch shape."""
 
         def total(pm, qm, pg, qg):
             h1 = jax.nn.relu(
@@ -175,6 +182,28 @@ class NCF(LatentFactorModel):
             (xu == u).astype(jnp.float32),
             (xi == i).astype(jnp.float32),
         )
+
+    def grads_from_rows(self, params, rows, x, y, u, i):
+        """(g, e) from pre-gathered table rows (see base hook doc):
+        the ``_own_grads_from_rows`` backward plus the forward re-run
+        with every table index replaced by its gathered row — the same
+        graphs ``block_row_grads``/``predict`` build, so the
+        row-sharded flat path is bitwise the replicated one."""
+        xu, xi = x[:, 0], x[:, 1]
+        pm, qm = rows["P_mlp"], rows["Q_mlp"]
+        pg, qg = rows["P_gmf"], rows["Q_gmf"]
+        g = self._masked_block_concat(
+            self._own_grads_from_rows(params, (pm, qm, pg, qg)),
+            (xu == u).astype(jnp.float32),
+            (xi == i).astype(jnp.float32),
+        )
+        h1 = jax.nn.relu(
+            jnp.concatenate([pm, qm], axis=-1) @ params["W1"] + params["b1"]
+        )
+        h2 = jax.nn.relu(h1 @ params["W2"] + params["b2"])
+        h = jnp.concatenate([h2, pg * qg], axis=-1)
+        pred = jnp.squeeze(h @ params["W3"] + params["b3"], axis=-1)
+        return g, pred - y
 
     # -- fused score-kernel hooks (see base doc + influence/kernels/ncf.py):
     # the kernel replays the forward to the relu masks and runs the

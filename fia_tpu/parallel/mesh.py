@@ -90,6 +90,16 @@ def surviving_mesh(mesh: Mesh, lost_ids=()) -> Mesh | None:
     shrink itself does. Returns ``None`` when no device would survive
     (or nothing would shrink — a named loss set disjoint from the
     mesh), so callers shed classified instead of rebuilding in place.
+
+    A 2-D mesh with model parallelism keeps its trailing axis sizes
+    when enough survivors remain to fill whole 'model' groups (excess
+    survivors past the last full group are dropped too): a
+    ``shard_tables`` engine re-placed on the shrunk mesh then stays
+    row-sharded instead of silently re-replicating tables that may not
+    fit one device. Only when survivors cannot fill even one group
+    does the mesh collapse to trailing-axis size 1 (the engine's
+    ``_sharded_now`` degrades to replicated placement — last resort
+    over dying).
     """
     devs = list(mesh.devices.flat)
     lost = frozenset(int(i) for i in lost_ids)
@@ -101,7 +111,15 @@ def surviving_mesh(mesh: Mesh, lost_ids=()) -> Mesh | None:
         keep = devs[:-1]
     if not keep:
         return None
-    shape = (len(keep),) + (1,) * (len(mesh.axis_names) - 1)
+    tail = tuple(int(mesh.shape[a]) for a in mesh.axis_names[1:])
+    mp = 1
+    for t in tail:
+        mp *= t
+    if mp > 1 and len(keep) >= mp:
+        keep = keep[: (len(keep) // mp) * mp]
+        shape = (len(keep) // mp,) + tail
+    else:
+        shape = (len(keep),) + (1,) * (len(mesh.axis_names) - 1)
     return Mesh(np.asarray(keep).reshape(shape), tuple(mesh.axis_names))
 
 

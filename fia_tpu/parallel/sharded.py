@@ -4,14 +4,26 @@ The scaling axes of this workload are #users/#items (embedding-table
 rows) and #queries/#train-rows (data) — there is no sequence dimension
 (SURVEY.md §2.4). For stress configs whose tables exceed one device's
 HBM (e.g. MovieLens-20M at large k), tables are row-sharded over a
-'model' mesh axis while queries/batches shard over 'data'; XLA inserts
-the gather/psum collectives over ICI.
+'model' mesh axis while queries/batches shard over 'data'.
+
+Two sharded regimes coexist:
+
+- the padded per-query path leaves the gathers to GSPMD, which inserts
+  collectives wherever a sharded table is indexed;
+- the flat hot path (engine ``shard_tables=True``) gathers the exact
+  per-query block rows ONCE per dispatch through
+  :func:`gather_table_rows` — an explicit masked-local-gather + psum
+  over the 'model' axis — and runs every downstream per-query op on
+  locally-resident rows, so the fused score program never touches a
+  table again (docs/design.md §20).
 """
 
 from __future__ import annotations
 
 import jax
+import jax.numpy as jnp
 import numpy as np
+from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 #: param names holding per-user/per-item rows, per model class name
@@ -19,6 +31,23 @@ TABLE_PARAMS = {
     "MF": ("P", "Q", "bu", "bi"),
     "NCF": ("P_mlp", "Q_mlp", "P_gmf", "Q_gmf"),
 }
+
+#: which id axis indexes each table's rows, aligned with TABLE_PARAMS
+TABLE_ROW_AXES = {
+    "MF": ("user", "item", "user", "item"),
+    "NCF": ("user", "item", "user", "item"),
+}
+
+
+def table_names(model) -> tuple[str, ...]:
+    return TABLE_PARAMS.get(type(model).__name__, ())
+
+
+def padded_rows(n: int, parts: int) -> int:
+    """Smallest multiple of ``parts`` >= ``n`` — the physical row count
+    of a table row-sharded over ``parts`` devices by
+    :func:`gather_table_rows` (shard_map needs divisible globals)."""
+    return -(-int(n) // int(parts)) * int(parts)
 
 
 def make_2d_mesh(n_devices: int | None = None, model_parallel: int = 2) -> Mesh:
@@ -38,25 +67,117 @@ def make_2d_mesh(n_devices: int | None = None, model_parallel: int = 2) -> Mesh:
     return Mesh(np.asarray(devs).reshape(n // mp, mp), ("data", "model"))
 
 
-def shard_model_params(mesh: Mesh, params, model, axis: str = "model"):
+def shard_model_params(mesh: Mesh, params, model, axis: str = "model",
+                       pad_rows: bool = True):
     """Row-shard the embedding tables over ``axis``; replicate the rest.
 
-    Row counts not divisible by the axis size are handled by XLA's
-    implicit padding of sharded dimensions. Multi-process meshes are
-    supported via ``distributed.put_global`` (each process serves the
-    shards its devices own).
+    Row counts not divisible by the axis size are zero-padded on the
+    leading dim to a :func:`padded_rows` multiple before placement
+    (``pad_rows``, on by default): ``device_put`` with a NamedSharding
+    requires divisible global dims — there is no implicit padding on
+    the placement path — and the flat hot path's ``shard_map`` gather
+    needs divisible global shapes anyway. Real row ids never reach the
+    pad rows (they are beyond ``num_users``/``num_items``), so
+    predictions, regularizer sums, and the engine's per-leaf sum/norm
+    params fingerprint are all exactly unchanged (appended zeros
+    contribute +0.0). ``pad_rows=False`` is for divisible-by-
+    construction configs that must keep the logical shape. Multi-
+    process meshes are supported via ``distributed.put_global`` (each
+    process serves the shards its devices own).
     """
     from fia_tpu.parallel.distributed import put_global
 
-    names = TABLE_PARAMS.get(type(model).__name__, ())
+    names = table_names(model)
+    parts = int(mesh.shape[axis])
     out = {}
     for k, v in params.items():
         if k in names:
+            if pad_rows:
+                pr = padded_rows(v.shape[0], parts)
+                if pr != int(v.shape[0]):
+                    v = jnp.pad(
+                        v, ((0, pr - int(v.shape[0])),)
+                        + ((0, 0),) * (v.ndim - 1)
+                    )
             spec = P(axis, *([None] * (v.ndim - 1)))
         else:
             spec = P()
         out[k] = put_global(mesh, v, spec)
     return out
+
+
+def gather_table_rows(mesh: Mesh, model, params, uids, iids,
+                      axis: str = "model"):
+    """Gather per-row table slices from row-sharded tables.
+
+    ``uids``/``iids`` are ``(ndev, S)`` int32 id arrays placed along the
+    'data' axis (one query/flat-row shard per data row). Returns
+    ``{table_name: (ndev, S, ...) rows}`` for every ``TABLE_PARAMS``
+    entry of the model, each placed ``P('data', None, ...)``.
+
+    The collective is one masked local gather + psum over ``axis``: the
+    shard owning global row ``r`` (``r // rows_local``) contributes the
+    real row, every other shard an exact ``+0.0`` (``jnp.where``, not a
+    mask multiply — no ``-0.0`` sign surprises from ``0 * x``), so the
+    psum reproduces the replicated gather bit-for-bit (``x + 0.0 == x``
+    in IEEE-754 for every finite x; trained rows are never ``-0.0``).
+    After this single collective, all per-query block math is local to
+    the query's data shard — the fused kernels and the bitwise
+    query-axis contract (docs/design.md §15) are untouched.
+
+    Registered as a dispatch-path function for FIA204/FIA205: it IS the
+    sanctioned cross-device fetch of the sharded hot path, and nothing
+    in it may transfer from host or place un-sharded.
+    """
+    names = table_names(model)
+    row_axes = TABLE_ROW_AXES[type(model).__name__]
+    tabs = tuple(params[n] for n in names)
+    in_specs = (P("data", None), P("data", None)) + tuple(
+        P(axis, *([None] * (t.ndim - 1))) for t in tabs
+    )
+    out_specs = {
+        n: P("data", None, *([None] * (t.ndim - 1)))
+        for n, t in zip(names, tabs)
+    }
+
+    def body(u_l, i_l, *tabs_l):
+        k = jax.lax.axis_index(axis)
+        out = {}
+        for n, rax, tl in zip(names, row_axes, tabs_l):
+            ids = u_l if rax == "user" else i_l
+            rows_local = tl.shape[0]
+            loc = ids - k * rows_local
+            ok = (loc >= 0) & (loc < rows_local)
+            r = jnp.take(tl, jnp.clip(loc, 0, rows_local - 1), axis=0)
+            okb = ok.reshape(ok.shape + (1,) * (tl.ndim - 1))
+            r = jnp.where(okb, r, jnp.zeros((), r.dtype))
+            out[n] = jax.lax.psum(r, axis)
+        return out
+
+    return shard_map(
+        body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=False,
+    )(uids, iids, *tabs)
+
+
+def per_device_table_bytes(params, model) -> int:
+    """Max bytes of table rows any single device holds — the residency
+    number the scale sweep / scale smoke report (shrinks ~linearly with
+    ``model_parallel`` when tables are row-sharded, equals the full
+    table footprint when replicated)."""
+    per_dev: dict = {}
+    for name in table_names(model):
+        v = params.get(name)
+        if v is None:
+            continue
+        shards = getattr(v, "addressable_shards", None)
+        if shards:
+            for sh in shards:
+                d = sh.device.id
+                per_dev[d] = per_dev.get(d, 0) + int(sh.data.nbytes)
+        else:
+            per_dev[0] = per_dev.get(0, 0) + int(np.asarray(v).nbytes)
+    return max(per_dev.values(), default=0)
 
 
 def replicate_rest(mesh: Mesh, tree):

@@ -1,0 +1,71 @@
+#!/usr/bin/env bash
+# Scale smoke: the row-sharded table path on 8 VIRTUAL CPU devices
+# (XLA_FLAGS=--xla_force_host_platform_device_count=8 — no chips
+# needed), via `python bench.py scale_sweep --quick --tiers 100k`,
+# asserting on the emitted artifact (docs/design.md §20):
+#   - bit_identity rows at 1/2/4/8 devices all report bit_identical
+#     (np.array_equal: sharded tables reproduce the replicated engine
+#     exactly, the query-axis contract extended to table placement)
+#   - per-device table bytes shrink with model_parallel: every mp>1
+#     row holds < replicated/mp * 1.25 bytes (25% slack covers the
+#     divisibility pad rows), strictly below the replicated row
+#   - every tier row's steady_state_compiles == 0 (AOT armed the
+#     sharded executable; the hot path never traced)
+#
+#   bash scripts/scale_smoke.sh        (or: make scale-smoke)
+#
+# Budget: <180s on CPU — smallest (100k-user) tier only, no training.
+# The full 1m/5m/10m sweep is `python bench.py scale_sweep`.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+DIR=$(mktemp -d /tmp/fia_scale_smoke.XXXXXX)
+trap 'rm -rf "$DIR"' EXIT
+
+XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+  timeout -k 10 420 python bench.py scale_sweep --quick --tiers 100k \
+  --json_out "$DIR/scale.json"
+
+python - "$DIR/scale.json" <<'EOF'
+import json, sys
+
+with open(sys.argv[1]) as fh:
+    out = json.load(fh)
+d = out["details"]
+assert d["device_count"] >= 8, f"virtual devices missing: {d['device_count']}"
+
+bits = d["bit_identity"]
+devs = [r.get("devices") for r in bits]
+assert devs == [1, 2, 4, 8], f"bit-identity rows incomplete: {devs}"
+for r in bits:
+    assert r["bit_identical"], f"sharded scores diverged: {r}"
+assert any(r["sharded"] for r in bits), "no sharded bit-identity row ran"
+
+tier = d["tiers"]["100k"]
+full = tier["replicated_table_bytes"]
+rows = {r.get("model_parallel"): r for r in tier["rows"]}
+assert sorted(rows) == [1, 2, 4, 8], f"mp rows incomplete: {sorted(rows)}"
+for mp, r in sorted(rows.items()):
+    assert "error" not in r, f"tier row failed: {r}"
+    assert r["scores_per_sec"] > 0, f"trivial tier row: {r}"
+    assert r["steady_state_compiles"] == 0, (
+        f"mp={mp} dispatch compiled in steady state: {r}"
+    )
+repl = rows[1]["per_device_table_bytes"]
+assert repl == full, f"replicated row holds {repl} != full tables {full}"
+for mp, r in sorted(rows.items()):
+    if mp == 1:
+        continue
+    pdb = r["per_device_table_bytes"]
+    assert pdb < repl, f"mp={mp} did not shrink table residency: {r}"
+    assert pdb <= full / mp * 1.25, (
+        f"mp={mp} per-device table bytes {pdb} exceed "
+        f"replicated/{mp} + 25% pad slack ({full / mp * 1.25:.0f})"
+    )
+shrink = [round(rows[mp]["per_device_table_bytes"] / full, 3)
+          for mp in (2, 4, 8)]
+print(f"scale smoke: bit-identity {devs} ok, "
+      f"table residency vs replicated at mp=2/4/8: {shrink}")
+EOF
+
+echo "scale-smoke PASS"

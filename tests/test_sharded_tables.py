@@ -1,0 +1,313 @@
+"""Row-sharded embedding tables (docs/design.md §20).
+
+The contract under test: a ``shard_tables=True`` engine on a 2-D
+('data', 'model') mesh serves scores BIT-IDENTICAL (``np.array_equal``)
+to the replicated single-device engine, while each device holds only
+its row shard of the user/item tables — and device-loss recovery
+re-places *sharded* tables, never silently re-replicates them.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fia_tpu.data.dataset import RatingDataset
+from fia_tpu.influence.engine import InfluenceEngine
+from fia_tpu.models import MF, NCF
+from fia_tpu.parallel.mesh import make_mesh, surviving_mesh
+from fia_tpu.parallel.sharded import (
+    TABLE_PARAMS,
+    gather_table_rows,
+    make_2d_mesh,
+    padded_rows,
+    per_device_table_bytes,
+    shard_model_params,
+    table_names,
+)
+
+
+def _setup(cls=MF, seed=0, n=600, users=23, items=17, k=4):
+    rng = np.random.default_rng(seed)
+    x = np.stack([rng.integers(0, users, n), rng.integers(0, items, n)],
+                 axis=1).astype(np.int32)
+    y = rng.integers(1, 6, n).astype(np.float32)
+    train = RatingDataset(x, y)
+    model = cls(users, items, k, 1e-3)
+    params = model.init_params(jax.random.PRNGKey(seed))
+    return model, params, train
+
+
+PTS = np.array([[3, 5], [0, 1], [7, 2], [11, 9], [1, 1], [22, 16], [4, 4]])
+
+
+class TestMake2dMesh:
+    def test_shape_and_axes(self):
+        mesh = make_2d_mesh(8, model_parallel=2)
+        assert mesh.axis_names == ("data", "model")
+        assert mesh.shape["data"] == 4 and mesh.shape["model"] == 2
+
+    @pytest.mark.parametrize("mp", [3, 5, 7])
+    def test_non_divisible_raises(self, mp):
+        with pytest.raises(ValueError, match="does not divide"):
+            make_2d_mesh(8, model_parallel=mp)
+
+    def test_model_parallel_exceeding_devices_raises(self):
+        with pytest.raises(ValueError):
+            make_2d_mesh(4, model_parallel=8)
+
+
+class TestShardModelParams:
+    @pytest.mark.parametrize("cls", [MF, NCF])
+    def test_every_table_row_sharded(self, cls):
+        """Each TABLE_PARAMS entry is split along dim 0; everything
+        else is fully replicated."""
+        model, params, _ = _setup(cls)
+        mesh = make_2d_mesh(8, model_parallel=2)
+        placed = shard_model_params(mesh, params, model)
+        names = set(TABLE_PARAMS[cls.__name__])
+        assert names == set(table_names(model))
+        for k, v in placed.items():
+            spec = v.sharding.spec
+            if k in names:
+                assert spec[0] == "model", (k, spec)
+                shard = next(iter(v.addressable_shards))
+                assert shard.data.shape[0] < v.shape[0], k
+            else:
+                assert v.sharding.is_fully_replicated, k
+
+    def test_non_divisible_rows_padded_to_divisible(self):
+        """Row counts not divisible by the axis size still place:
+        ``device_put`` has no implicit padding, so the leading dim is
+        zero-padded to the next divisible multiple explicitly."""
+        model, params, _ = _setup(users=23, items=17)  # neither % 4 == 0
+        mesh = make_2d_mesh(8, model_parallel=4)
+        placed = shard_model_params(mesh, params, model)
+        for name in table_names(model):
+            v = placed[name]
+            assert v.shape[0] == padded_rows(params[name].shape[0], 4)
+            assert v.shape[0] % 4 == 0
+            assert v.sharding.spec[0] == "model"
+
+    def test_pad_rows_appends_exact_zeros(self):
+        model, params, _ = _setup(users=23, items=17)
+        mesh = make_2d_mesh(8, model_parallel=4)
+        placed = shard_model_params(mesh, params, model, pad_rows=True)
+        for name in table_names(model):
+            orig = np.asarray(params[name])
+            got = np.asarray(placed[name])
+            pr = padded_rows(orig.shape[0], 4)
+            assert got.shape[0] == pr and pr % 4 == 0
+            np.testing.assert_array_equal(got[: orig.shape[0]], orig)
+            assert not np.any(got[orig.shape[0]:])
+
+    def test_per_device_table_bytes_shrink(self):
+        model, params, _ = _setup(users=64, items=32)
+        full = sum(np.asarray(params[n]).nbytes for n in table_names(model))
+        mesh = make_2d_mesh(8, model_parallel=4)
+        placed = shard_model_params(mesh, params, model, pad_rows=True)
+        assert per_device_table_bytes(placed, model) == full // 4
+
+
+class TestGatherTableRows:
+    @pytest.mark.parametrize("cls", [MF, NCF])
+    @pytest.mark.parametrize("mp", [2, 4])
+    def test_bitwise_vs_direct_indexing(self, cls, mp):
+        model, params, _ = _setup(cls, users=24, items=16)
+        mesh = make_2d_mesh(8, model_parallel=mp)
+        placed = shard_model_params(mesh, params, model, pad_rows=True)
+        ndev = int(mesh.shape["data"])
+        rng = np.random.default_rng(3)
+        uids = rng.integers(0, 24, size=(ndev, 5)).astype(np.int32)
+        iids = rng.integers(0, 16, size=(ndev, 5)).astype(np.int32)
+        rows = gather_table_rows(mesh, model, placed, jnp.asarray(uids),
+                                 jnp.asarray(iids))
+        from fia_tpu.parallel.sharded import TABLE_ROW_AXES
+
+        for name, rax in zip(table_names(model),
+                             TABLE_ROW_AXES[cls.__name__]):
+            ids = uids if rax == "user" else iids
+            want = np.asarray(params[name])[ids]
+            np.testing.assert_array_equal(np.asarray(rows[name]), want)
+
+
+class TestShardedEngine:
+    @pytest.mark.parametrize("mp", [2, 4, 8])
+    def test_flat_query_bitwise_vs_replicated(self, mp):
+        model, params, train = _setup()
+        single = InfluenceEngine(model, params, train, damping=1e-3,
+                                 impl="flat")
+        base = single.query_batch(PTS)
+        eng = InfluenceEngine(model, params, train, damping=1e-3,
+                              impl="flat",
+                              mesh=make_2d_mesh(8, model_parallel=mp),
+                              shard_tables=True)
+        assert eng._flat_eligible() and eng._sharded_now()
+        got = eng.query_batch(PTS, pad_to=base.scores.shape[1])
+        for t in range(len(PTS)):
+            assert np.array_equal(got.scores_of(t), base.scores_of(t))
+        assert np.array_equal(got.ihvp, base.ihvp)
+        assert np.array_equal(got.test_grad, base.test_grad)
+
+    def test_ncf_flat_query_bitwise_vs_replicated(self):
+        model, params, train = _setup(NCF)
+        single = InfluenceEngine(model, params, train, damping=1e-3,
+                                 impl="flat")
+        base = single.query_batch(PTS)
+        eng = InfluenceEngine(model, params, train, damping=1e-3,
+                              impl="flat",
+                              mesh=make_2d_mesh(8, model_parallel=2),
+                              shard_tables=True)
+        got = eng.query_batch(PTS, pad_to=base.scores.shape[1])
+        for t in range(len(PTS)):
+            assert np.array_equal(got.scores_of(t), base.scores_of(t))
+
+    def test_tables_resident_sharded(self):
+        model, params, train = _setup()
+        eng = InfluenceEngine(model, params, train, damping=1e-3,
+                              impl="flat",
+                              mesh=make_2d_mesh(8, model_parallel=4),
+                              shard_tables=True)
+        full = sum(np.asarray(params[n]).nbytes for n in table_names(model))
+        assert per_device_table_bytes(eng.params, model) < full
+
+    def test_shard_tables_requires_model_axis(self):
+        model, params, train = _setup()
+        with pytest.raises(ValueError, match="model"):
+            InfluenceEngine(model, params, train, damping=1e-3,
+                            mesh=make_mesh(8), shard_tables=True)
+
+    def test_shard_tables_rejects_pallas(self):
+        model, params, train = _setup()
+        with pytest.raises(ValueError, match="pallas"):
+            InfluenceEngine(model, params, train, damping=1e-3,
+                            kernel="pallas",
+                            mesh=make_2d_mesh(8, model_parallel=2),
+                            shard_tables=True)
+
+    def test_aot_zero_steady_state_compiles(self):
+        from fia_tpu.utils import compilemon
+
+        model, params, train = _setup()
+        eng = InfluenceEngine(model, params, train, damping=1e-3,
+                              impl="flat",
+                              mesh=make_2d_mesh(8, model_parallel=2),
+                              shard_tables=True)
+        geom = eng.flat_geometry(PTS)
+        aot = eng.precompile_flat([geom])
+        assert list(geom) in aot["compiled"]
+        eng.query_batch(PTS)  # warm the host packing path
+        c0 = compilemon.count()
+        eng.query_batch(PTS)
+        assert compilemon.count() - c0 == 0
+
+
+class TestShardedRecovery:
+    def test_surviving_mesh_preserves_model_axis(self):
+        mesh = make_2d_mesh(8, model_parallel=2)
+        m = surviving_mesh(mesh)  # 7 survivors -> 3 full groups of 2
+        assert tuple(int(m.shape[a]) for a in m.axis_names) == (3, 2)
+
+    def test_surviving_mesh_collapses_below_one_group(self):
+        mesh = make_2d_mesh(2, model_parallel=2)
+        m = surviving_mesh(mesh)  # 1 survivor < mp
+        assert tuple(int(m.shape[a]) for a in m.axis_names) == (1, 1)
+
+    def test_surviving_mesh_1d_unchanged(self):
+        m = surviving_mesh(make_mesh(8))
+        assert tuple(int(m.shape[a]) for a in m.axis_names) == (7,)
+
+    def test_rebuild_preserves_sharded_placement(self):
+        """Device loss on a shard_tables engine re-places *sharded*
+        tables on the shrunk mesh — and stays bit-identical."""
+        model, params, train = _setup()
+        single = InfluenceEngine(model, params, train, damping=1e-3,
+                                 impl="flat")
+        base = single.query_batch(PTS)
+        mesh = make_2d_mesh(8, model_parallel=2)
+        eng = InfluenceEngine(model, params, train, damping=1e-3,
+                              impl="flat", mesh=mesh, shard_tables=True)
+        eng.query_batch(PTS)
+        shrunk = surviving_mesh(mesh)
+        eng.rebuild_mesh(shrunk)
+        assert eng._sharded_now()
+        full = sum(np.asarray(params[n]).nbytes for n in table_names(model))
+        assert per_device_table_bytes(eng.params, model) < full
+        got = eng.query_batch(PTS, pad_to=base.scores.shape[1])
+        for t in range(len(PTS)):
+            assert np.array_equal(got.scores_of(t), base.scores_of(t))
+
+    def test_rebuild_to_trivial_model_axis_degrades_replicated(self):
+        model, params, train = _setup()
+        single = InfluenceEngine(model, params, train, damping=1e-3,
+                                 impl="flat")
+        base = single.query_batch(PTS)
+        eng = InfluenceEngine(model, params, train, damping=1e-3,
+                              impl="flat",
+                              mesh=make_2d_mesh(2, model_parallel=2),
+                              shard_tables=True)
+        eng.rebuild_mesh(surviving_mesh(eng.mesh))  # -> (1, 1)
+        assert not eng._sharded_now()
+        got = eng.query_batch(PTS, pad_to=base.scores.shape[1])
+        for t in range(len(PTS)):
+            assert np.array_equal(got.scores_of(t), base.scores_of(t))
+
+
+class TestShardedBank:
+    def test_bank_hits_bitwise_vs_replicated(self, tmp_path):
+        from fia_tpu.influence import factor as fbank
+
+        model, params, train = _setup(users=30, items=20)
+
+        def eng_of(**kw):
+            return InfluenceEngine(
+                model, params, train, damping=1e-3, cache_dir=str(tmp_path),
+                model_name="tshard", lissa_depth=30, **kw,
+            )
+
+        builder = eng_of(solver="direct")
+        pairs = fbank.select_hot_pairs(builder.index, max_entries=16,
+                                       top_users=5, top_items=5)
+        bank = fbank.build_bank(builder, pairs, batch_queries=16)
+        fp = fbank.bank_fingerprint("tshard", model.block_size, 1e-3,
+                                    *builder._train_host)
+        fbank.publish_bank(bank, builder.factor_bank_path(), fp)
+
+        ref = eng_of(solver="precomputed")
+        ref.ensure_factor_bank()
+        pts = np.asarray(bank.pairs[:8], np.int64)
+        base = ref.query_batch(pts)
+        assert ref.bank_stats()["hits"] == len(pts)
+
+        eng = eng_of(solver="precomputed",
+                     mesh=make_2d_mesh(8, model_parallel=2),
+                     shard_tables=True)
+        eng.ensure_factor_bank()
+        got = eng.query_batch(pts, pad_to=base.scores.shape[1])
+        assert eng.bank_stats()["hits"] == len(pts)
+        for t in range(len(pts)):
+            assert np.array_equal(got.scores_of(t), base.scores_of(t))
+        assert np.array_equal(got.ihvp, base.ihvp)
+
+
+class TestScaleGenerator:
+    def test_deterministic_and_in_range(self):
+        from fia_tpu.data.synthetic import SCALE_TIERS, synthesize_scale
+
+        assert set(SCALE_TIERS) == {"100k", "1m", "5m", "10m"}
+        a = synthesize_scale(1000, 200, 5000, seed=3)
+        b = synthesize_scale(1000, 200, 5000, seed=3)
+        np.testing.assert_array_equal(a.x, b.x)
+        np.testing.assert_array_equal(a.y, b.y)
+        assert a.x[:, 0].max() < 1000 and a.x[:, 1].max() < 200
+        assert a.y.min() >= 1.0 and a.y.max() <= 5.0
+
+    def test_item_popularity_skewed(self):
+        from fia_tpu.data.synthetic import synthesize_scale
+
+        d = synthesize_scale(1000, 200, 20000, seed=0)
+        counts = np.bincount(d.x[:, 1], minlength=200)
+        top = np.sort(counts)[::-1]
+        # Zipf head: the top 10 items carry well over their uniform
+        # share (10/200 = 5%) of the rows
+        assert top[:10].sum() > 0.15 * counts.sum()
