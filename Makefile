@@ -1,7 +1,7 @@
 # Convenience targets. The commands themselves are pinned in
 # ROADMAP.md (tier-1) and scripts/ — these targets just name them.
 
-.PHONY: tier1 test lint lint-io serve-smoke multichip-smoke factor-smoke chaos-smoke chaos-soak churn-smoke degraded-smoke kernel-smoke scale-smoke
+.PHONY: tier1 test lint lint-io serve-smoke multichip-smoke factor-smoke chaos-smoke chaos-soak churn-smoke degraded-smoke kernel-smoke scale-smoke obs-smoke
 
 # The ROADMAP.md tier-1 verify: fast CPU suite, slow tests excluded.
 # Lint is fatal — a finding fails the build before pytest runs.
@@ -63,6 +63,14 @@ churn-smoke:
 # (docs/design.md §19).
 kernel-smoke:
 	bash scripts/kernel_smoke.sh
+
+# Obs smoke: the tracing/metrics spine end to end on CPU (<30s) —
+# traced serve stream with complete span chains (cli.obs report gates
+# on the audit), scores byte-identical trace-on/off, Perfetto +
+# Prometheus exporters, latency-report histogram sections
+# (docs/observability.md).
+obs-smoke:
+	bash scripts/obs_smoke.sh
 
 # Degraded smoke: the r12 survival paths on CPU (<60s, 8 virtual
 # devices) — one forced device loss (4-device mesh shrinks to 3,

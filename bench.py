@@ -553,30 +553,54 @@ def main():
 
             packed_null = _pack_null()
             jax.block_until_ready(jax.device_put(packed_null))  # warm
+            # Stage timers ride the obs span API (docs/observability.md):
+            # each timed round is one bench.stage.<name> span plus one
+            # bench.stage_us{stage=...} histogram sample, so the stage
+            # split is reconstructable from the JSONL/registry exactly
+            # like serving latencies. The span wraps _timed (the span
+            # machinery's own cost never lands inside the measurement).
+            from fia_tpu import obs
+            from fia_tpu.obs.export import span_fields
+
+            def _timed_stage(stage, fn):
+                with obs.span(f"bench.stage.{stage}"):
+                    dt = _timed(fn)
+                obs.REGISTRY.histogram(
+                    "bench.stage_us", stage=stage
+                ).observe(dt * 1e6)
+                return dt
+
+            was_tracing = obs.tracing_enabled()
+            obs.configure(trace=True)
             best = {st: float("inf") for st in stages}
             null_best = float("inf")
             null_jit_best = float("inf")
             pack_best = float("inf")
             xfer_best = float("inf")
             for _ in range(3):
-                null_best = min(null_best, _timed(
-                    lambda: float(null_exe(null_x))
+                null_best = min(null_best, _timed_stage(
+                    "null_launch", lambda: float(null_exe(null_x))
                 ))
-                null_jit_best = min(null_jit_best, _timed(
-                    lambda: float(null_jit(null_host))
+                null_jit_best = min(null_jit_best, _timed_stage(
+                    "null_jit_dispatch", lambda: float(null_jit(null_host))
                 ))
-                pack_best = min(pack_best, _timed(_pack_null))
-                xfer_best = min(xfer_best, _timed(
-                    lambda: jax.block_until_ready(
+                pack_best = min(pack_best, _timed_stage(
+                    "null_host_packing", _pack_null
+                ))
+                xfer_best = min(xfer_best, _timed_stage(
+                    "null_transfer", lambda: jax.block_until_ready(
                         jax.device_put(packed_null)
                     )
                 ))
                 for st in stages:
-                    best[st] = min(best[st], _timed(
-                        lambda f=fns[st]: jax.block_until_ready(
+                    best[st] = min(best[st], _timed_stage(
+                        st, lambda f=fns[st]: jax.block_until_ready(
                             f(*split_args)
                         )
                     ))
+            obs.configure(trace=was_tracing)
+            for _sp in obs.TRACER.flush():
+                log.log("obs.span", **span_fields(_sp))
             device_split["null_dispatch_ms"] = round(null_best * 1e3, 2)
             device_split["null_jit_dispatch_ms"] = round(
                 null_jit_best * 1e3, 2
@@ -755,6 +779,65 @@ def main():
     except Exception as e:  # noqa: BLE001 — keep the headline rows
         _stage(f"solver tier stage FAILED: {e!r}")
         solver_tier = {"error": repr(e)}
+
+    # --- obs overhead gate (docs/observability.md) ----------------------
+    # Tracing must be effectively free on the hot path: A/B the SAME
+    # warmed dispatch with the tracer off vs on (min-of-N each) and
+    # commit overhead < 2% of the trace-off wall. Like drift_alert and
+    # the grads gate, a breach does not fail the run — it lands in the
+    # artifact AND on stderr so a tracing-cost regression is loud.
+    obs_overhead = {}
+    try:
+        from fia_tpu import obs as _obs
+
+        pts_ov = points[:64]
+        engine.query_batch(pts_ov)  # warm this geometry's packing path
+
+        # Interleave off/on rounds (rather than one block each) so a
+        # mid-measurement frequency/load shift hits both arms equally:
+        # at ~10 ms per dispatch the raw jitter between two back-to-back
+        # blocks is itself several percent — larger than the cost being
+        # measured — and min-of-interleaved is robust to it.
+        reps_ov = 12 if QUICK else 20
+        prev_tracing = _obs.tracing_enabled()
+        off_s = on_s = float("inf")
+        for _ in range(reps_ov):
+            _obs.configure(trace=False)
+            off_s = min(off_s, _timed(lambda: engine.query_batch(pts_ov)))
+            _obs.configure(trace=True)
+            with _obs.trace("bench-obs-overhead"):
+                on_s = min(on_s,
+                           _timed(lambda: engine.query_batch(pts_ov)))
+        _obs.configure(trace=prev_tracing)
+        _obs.TRACER.flush()  # drop the A/B spans; the numbers carry it
+        frac = (on_s - off_s) / off_s if off_s > 0 else 0.0
+        committed_ov = 0.02
+        obs_overhead = {
+            "trace_off_ms": round(off_s * 1e3, 3),
+            "trace_on_ms": round(on_s * 1e3, 3),
+            "overhead_frac": round(frac, 4),
+            "committed_max_frac": committed_ov,
+            "alert": frac > committed_ov,
+            "queries": int(len(pts_ov)),
+            "best_of": reps_ov,
+        }
+        log.log("obs_overhead", model="MF", **obs_overhead)
+        if obs_overhead["alert"]:
+            print(
+                f"bench: OBS OVERHEAD ALERT — tracing-on dispatch "
+                f"{obs_overhead['trace_on_ms']} ms is "
+                f"{frac:+.1%} vs tracing-off "
+                f"{obs_overhead['trace_off_ms']} ms (committed < "
+                f"{committed_ov:.0%}). The span path grew a hot-path "
+                f"cost — check fia_tpu/obs/trace.py before trusting "
+                f"per-request latencies.",
+                file=sys.stderr,
+            )
+        _stage(f"obs overhead: {frac:+.2%} (trace on vs off, "
+               f"best-of-{reps_ov})")
+    except Exception as e:  # noqa: BLE001 — keep the headline rows
+        _stage(f"obs overhead stage FAILED: {e!r}")
+        obs_overhead = {"error": repr(e)}
     _stage(f"running CPU reference on {n_base} queries")
 
     # --- CPU baseline (reference-architecture engine) on a sample -------
@@ -934,6 +1017,7 @@ def main():
             "dispatch": dispatch,
             "device_sweep": device_sweep,
             "solver_tier": solver_tier,
+            "obs_overhead": obs_overhead,
             "ncf": ncf_out,
         },
     }
@@ -1456,6 +1540,18 @@ def scale_sweep_main():
                     best_dt = min(best_dt,
                                   _timed(lambda: eng.query_batch(pts)))
                 pdb = per_device_table_bytes(eng.params, model)
+                hbm = _hbm_high_water()
+                # residency gauges mirror into the obs registry so the
+                # scale artifact and a Prometheus scrape agree
+                from fia_tpu import obs
+
+                obs.REGISTRY.gauge(
+                    "bench.table_bytes_per_device", tier=tier, mp=mp
+                ).set(int(pdb))
+                if hbm:
+                    obs.REGISTRY.gauge(
+                        "bench.hbm_high_water_bytes"
+                    ).max(int(hbm))
                 row = {
                     "model_parallel": mp,
                     "scores_per_sec": round(
@@ -1466,7 +1562,7 @@ def scale_sweep_main():
                     "table_bytes_vs_replicated": round(
                         pdb / full_bytes, 4
                     ),
-                    "hbm_high_water_bytes": _hbm_high_water(),
+                    "hbm_high_water_bytes": hbm,
                     # honest fallback where the backend reports no
                     # memory stats (CPU): tables + train tensors
                     "resident_bytes_est": int(

@@ -54,12 +54,17 @@ REGISTERED_JIT_ENTRY_POINTS = (
 # per-query dispatch wall the fused path exists to kill. Entries are
 # (path suffix, bare function name), like REGISTERED_JIT_ENTRY_POINTS.
 DISPATCH_PATH_FUNCTIONS = (
+    # _dispatch_flat / drain are thin obs-span wrappers since the obs
+    # spine landed; the registered names stay (the wrappers must remain
+    # transfer-free too) and the *_inner/_impl bodies are policed.
     ("fia_tpu/influence/engine.py", "_dispatch_flat"),
+    ("fia_tpu/influence/engine.py", "_dispatch_flat_inner"),
     ("fia_tpu/influence/engine.py", "_finalize_flat"),
     ("fia_tpu/influence/engine.py", "query_many"),
     ("fia_tpu/influence/engine.py", "_query_bank_hits"),
     ("fia_tpu/serve/service.py", "_dispatch_misses"),
     ("fia_tpu/serve/service.py", "drain"),
+    ("fia_tpu/serve/service.py", "_drain_impl"),
     # The sharded hot path's one sanctioned cross-device fetch: the
     # masked-gather + psum collective that pulls per-query block rows
     # out of the row-sharded tables (docs/design.md §20). Registered so
@@ -136,3 +141,29 @@ METRICS_CONSUMER = "scripts/latency_report.py"
 METRICS_SCOPE = "fia_tpu/serve/"
 # Fields every EventLog record carries implicitly.
 METRICS_IMPLICIT_FIELDS = frozenset({"t", "event"})
+
+# FIA401 (obs extension): the tracing/metrics event schema
+# (fia_tpu/obs/events.py SCHEMA) is unioned with the serving SCHEMA for
+# the producer-side checks, and every consumer below must declare a
+# CONSUMES literal checked against that union. Consumers are checked
+# only when the file exists under the lint root, so synthetic/foreign
+# trees lint clean without them; the reverse direction (every obs.*
+# event consumed by at least one consumer) runs only when the obs
+# schema itself was loaded.
+OBS_MODULE = "fia_tpu/obs/events.py"
+OBS_CONSUMERS = (
+    "scripts/latency_report.py",
+    "fia_tpu/cli/obs.py",
+)
+
+# FIA402: bare ``print(`` is banned in library code under this prefix —
+# stdout belongs to CLI mains (machine-readable JSON lines), and
+# human-facing diagnostics must ride the obs spine (fia_tpu.obs.diag:
+# stderr + counter + span event) so they are never lost. Exemptions:
+# CLI entry points own stdout; the linter's own terminal reporter is a
+# CLI in all but path.
+OBS_PRINT_SCOPE = "fia_tpu/"
+OBS_PRINT_EXEMPT_PREFIXES = (
+    "fia_tpu/cli/",
+    "fia_tpu/analysis/lint.py",
+)

@@ -141,6 +141,7 @@ def _load_builtin_rules() -> None:
     # import for registration side effects
     from fia_tpu.analysis import (  # noqa: F401
         rules_io,
+        rules_obs,
         rules_schema,
         rules_sites,
         rules_trace,

@@ -14,6 +14,23 @@ from the report:
   declared for that event;
 - every event/field in ``CONSUMES`` must exist in ``SCHEMA``.
 
+Since the obs spine landed the checked schema is the UNION of the
+serving ``SCHEMA`` and the obs event schema
+(``fia_tpu/obs/events.py`` ``SCHEMA`` — the ``obs.span`` /
+``obs.metrics`` lines the service mirrors into the same JSONL
+stream), and the consumer side covers every declared consumer
+(``config.OBS_CONSUMERS``: the latency report plus the
+``fia_tpu.cli.obs`` reader), in BOTH directions:
+
+- each consumer's ``CONSUMES`` ⊆ the union schema (a renamed field
+  breaks the reader loudly);
+- every ``obs.*`` event in the obs schema is consumed by at least one
+  declared consumer (an event nobody reads is dead weight on the hot
+  path).
+
+Extra consumers and the obs schema are checked only when their files
+exist under the lint root, so foreign/synthetic trees lint clean.
+
 ``t`` and ``event`` are implicit (EventLog stamps them on every
 record).
 """
@@ -100,17 +117,34 @@ class MetricsSchemaRule(ProjectRule):
             return findings
         implicit = config.METRICS_IMPLICIT_FIELDS
 
+        # obs extension: union in the tracing/metrics event schema when
+        # present (absent in synthetic/foreign trees — plain serve-only
+        # checking then)
+        obs_schema: dict = {}
+        if os.path.exists(os.path.join(root, config.OBS_MODULE)):
+            obs_schema, obs_ref = _load_decl(
+                root, config.OBS_MODULE, "SCHEMA"
+            )
+            if obs_schema is None:
+                findings.append(Finding(
+                    self.id, config.OBS_MODULE, 1, 0,
+                    f"missing obs event schema declaration: {obs_ref}",
+                ))
+                obs_schema = {}
+        union = {**schema, **obs_schema}
+
         # producer side: every .log("x.y", ...) in fia_tpu/serve/
         for sf in in_scope:
             for node, event, kwargs in _log_calls(sf):
-                if event not in schema:
+                if event not in union:
                     findings.append(Finding(
                         self.id, sf.rel, node.lineno, node.col_offset,
                         f"event {event!r} is not declared in "
-                        f"{config.METRICS_MODULE} SCHEMA",
+                        f"{config.METRICS_MODULE} SCHEMA (or the obs "
+                        f"schema at {config.OBS_MODULE})",
                     ))
                     continue
-                undeclared = sorted(kwargs - schema[event] - implicit)
+                undeclared = sorted(kwargs - union[event] - implicit)
                 if undeclared:
                     findings.append(Finding(
                         self.id, sf.rel, node.lineno, node.col_offset,
@@ -118,29 +152,49 @@ class MetricsSchemaRule(ProjectRule):
                         f"{', '.join(undeclared)} (add to SCHEMA or drop)",
                     ))
 
-        # consumer side: latency_report's CONSUMES ⊆ SCHEMA
-        consumes, c_ref = _load_decl(
-            root, config.METRICS_CONSUMER, "CONSUMES"
-        )
-        if consumes is None:
-            findings.append(Finding(
-                self.id, config.METRICS_CONSUMER, 1, 0,
-                f"missing consumer declaration: {c_ref}",
-            ))
-            return findings
-        for event, fields in sorted(consumes.items()):
-            if event not in schema:
+        # consumer side: each declared consumer's CONSUMES ⊆ the union
+        # schema. The latency report is mandatory (the original
+        # contract); extra obs consumers are checked when present.
+        consumers = [config.METRICS_CONSUMER] + [
+            c for c in config.OBS_CONSUMERS
+            if c != config.METRICS_CONSUMER
+            and os.path.exists(os.path.join(root, c))
+        ]
+        consumed_events: set[str] = set()
+        for rel in consumers:
+            consumes, c_ref = _load_decl(root, rel, "CONSUMES")
+            if consumes is None:
                 findings.append(Finding(
-                    self.id, config.METRICS_CONSUMER, 1, 0,
-                    f"latency report consumes unknown event {event!r}",
+                    self.id, rel, 1, 0,
+                    f"missing consumer declaration: {c_ref}",
                 ))
                 continue
-            missing = sorted(set(fields) - schema[event] - implicit)
-            if missing:
-                findings.append(Finding(
-                    self.id, config.METRICS_CONSUMER, 1, 0,
-                    f"latency report consumes field(s) "
-                    f"{', '.join(missing)} that {event!r} does not emit "
-                    f"(SCHEMA at {config.METRICS_MODULE}:{schema_ref})",
-                ))
+            consumed_events |= set(consumes)
+            for event, fields in sorted(consumes.items()):
+                if event not in union:
+                    findings.append(Finding(
+                        self.id, rel, 1, 0,
+                        f"consumer {rel} reads unknown event {event!r}",
+                    ))
+                    continue
+                missing = sorted(set(fields) - union[event] - implicit)
+                if missing:
+                    findings.append(Finding(
+                        self.id, rel, 1, 0,
+                        f"consumer {rel} reads field(s) "
+                        f"{', '.join(missing)} that {event!r} does not "
+                        f"emit (SCHEMA at "
+                        f"{config.METRICS_MODULE}:{schema_ref})",
+                    ))
+
+        # reverse direction: every obs.* event someone emits must have
+        # at least one declared reader — an exported event nobody
+        # consumes is hot-path weight with no dashboard behind it
+        for event in sorted(set(obs_schema) - consumed_events):
+            findings.append(Finding(
+                self.id, config.OBS_MODULE, 1, 0,
+                f"obs event {event!r} is declared but no consumer "
+                f"({', '.join(consumers)}) reads it — wire it into a "
+                "CONSUMES or drop the event",
+            ))
         return findings

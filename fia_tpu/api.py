@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from fia_tpu import obs
 from fia_tpu.data.dataset import RatingDataset
 from fia_tpu.influence.engine import InfluenceEngine
 from fia_tpu.influence.full import FullInfluenceEngine
@@ -188,7 +189,7 @@ class FIAModel:
                 sent = True
         if not sent:
             body = " ".join(f"{k}={v}" for k, v in fields.items())
-            print(f"[{event}] {body}")
+            obs.diag(event, body)
 
     def _refresh_factor_bank(self):
         """Surgical factor-bank invalidation on a params/train change
@@ -300,12 +301,13 @@ class FIAModel:
                 jnp.concatenate([jnp.ravel(l) for l in jax.tree_util.tree_leaves(g)])
             )
         )
-        print(f"Train loss (w reg) on all data: {loss_w}")
-        print(f"Train loss (w/o reg) on all data: {loss_wo}")
-        print(f"Test loss (w/o reg) on all data: {test_loss}")
-        print(f"Train acc on all data:  {train_mae}")
-        print(f"Test acc on all data:   {test_mae}")
-        print(f"Norm of the mean of gradients: {gnorm}")
+        # fialint: disable=FIA402 -- reference-format stdout report
+        print(f"Train loss (w reg) on all data: {loss_w}\n"
+              f"Train loss (w/o reg) on all data: {loss_wo}\n"
+              f"Test loss (w/o reg) on all data: {test_loss}\n"
+              f"Train acc on all data:  {train_mae}\n"
+              f"Test acc on all data:   {test_mae}\n"
+              f"Norm of the mean of gradients: {gnorm}")
 
     # -- influence (matrix_factorization.py:164-251) ------------------------
     def get_influence_on_test_loss(self, test_indices, train_idx=None,

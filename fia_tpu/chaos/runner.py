@@ -19,6 +19,7 @@ from __future__ import annotations
 import os
 from dataclasses import dataclass, field
 
+from fia_tpu import obs
 from fia_tpu.chaos import oracles as ochk
 from fia_tpu.chaos import schedule as sched
 from fia_tpu.chaos.oracles import OracleFailure, RunRecord
@@ -70,7 +71,7 @@ class ChaosEngine:
 
     def _say(self, msg: str) -> None:
         if self.verbose:
-            print(f"[chaos] {msg}")
+            obs.diag("chaos", msg)
 
     def scenario(self, name: str):
         if name not in self._classes:

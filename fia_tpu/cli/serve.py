@@ -79,11 +79,20 @@ def add_serve_flags(p):
     p.add_argument("--smoke_hot_frac", type=float, default=0.5,
                    help="smoke stream: fraction of requests drawn from "
                         "a small hot set of repeated queries")
+    p.add_argument("--trace", type=int, default=0,
+                   help="1: per-request span tracing — obs.span lines "
+                        "interleave into the metrics JSONL; render with "
+                        "python -m fia_tpu.cli.obs "
+                        "(docs/observability.md)")
     return p
 
 
 def build_service(args):
     """Model + engine + service from the shared CLI plumbing."""
+    if getattr(args, "trace", 0):
+        from fia_tpu import obs
+
+        obs.configure(trace=True)
     common.apply_backend(args)
     splits = common.load_splits(args)
     model, params = common.build_model(args, splits)

@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from fia_tpu import obs
 from fia_tpu.data.dataset import RatingDataset
 from fia_tpu.influence.engine import InfluenceEngine
 from fia_tpu.train.trainer import loo_retrain_many
@@ -74,8 +75,10 @@ def test_retraining(
 
     def stage(msg):
         if verbose:
-            print(f"rq1[{time.strftime('%H:%M:%S')}] test {test_idx}: {msg}",
-                  flush=True)
+            obs.diag(
+                "rq1",
+                f"{time.strftime('%H:%M:%S')} test {test_idx}: {msg}",
+            )
     model = engine.model
     params0 = engine.params
     rng = np.random.default_rng(random_seed)

@@ -32,6 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from fia_tpu import obs
 from fia_tpu.data.dataset import RatingDataset
 from fia_tpu.data.index import InteractionIndex, bucketed_pad
 from fia_tpu.influence import grads as G
@@ -576,6 +577,9 @@ class InfluenceEngine:
         the last rung before giving up entirely.
         """
         inject.fire(sites.MESH_REBUILD)
+        obs.REGISTRY.counter("engine.mesh_rebuilds").inc()
+        obs.event("mesh.rebuild",
+                  ndev=1 if mesh is None else int(mesh.devices.size))
         self.mesh = mesh
         self._multihost = False
         if mesh is not None:
@@ -1189,6 +1193,15 @@ class InfluenceEngine:
             return {"compiled": [], "cached": [], "seconds": 0.0}
         t0 = time.perf_counter()
         compiled, cached = [], []
+        # backend-compile events fired inside .compile() attach to this
+        # span via compilemon's obs mirror — AOT-key attribution
+        with obs.span("engine.precompile") as _osp:
+            self._precompile_geometries(geometries, compiled, cached)
+            _osp.set(compiled=len(compiled), cached=len(cached))
+        return {"compiled": compiled, "cached": cached,
+                "seconds": time.perf_counter() - t0}
+
+    def _precompile_geometries(self, geometries, compiled, cached):
         for t_pad, s_pad in geometries:
             t_pad, s_pad = int(t_pad), int(s_pad)
             key = self._aot_key(t_pad, s_pad)
@@ -1227,8 +1240,6 @@ class InfluenceEngine:
                 tx, self._rowfeat,
             ).compile()
             compiled.append([t_pad, s_pad])
-        return {"compiled": compiled, "cached": cached,
-                "seconds": time.perf_counter() - t0}
 
     def compiled_geometries(self) -> dict:
         """Compiled flat-program inventory (bench/serve reporting):
@@ -1244,13 +1255,31 @@ class InfluenceEngine:
         program (which compiles on first call)."""
         exe = self._aot.get(self._aot_key(t_pad, s_pad))
         if exe is not None:
+            obs.REGISTRY.counter("engine.aot_hits").inc()
             return exe
+        # jit path: compiles on first call for this geometry — the
+        # compile itself shows up via compilemon's obs mirror
+        obs.REGISTRY.counter("engine.aot_misses").inc()
         return self._flat_fn(s_pad, donate=self._donate_scratch())
 
     def _dispatch_flat(self, test_points: np.ndarray, pad_to: int | None):
         """Enqueue one flat query program; returns an opaque handle for
         :meth:`_finalize_flat`. Dispatch is async — the device starts
-        crunching while the host moves on."""
+        crunching while the host moves on.
+
+        Span-only wrapper: the dispatch body lives in
+        ``_dispatch_flat_inner`` (the function registered on the
+        FIA204/205 dispatch path in analysis/config.py)."""
+        with obs.span("engine.dispatch_flat",
+                      n=int(len(test_points))) as sp:
+            handle = self._dispatch_flat_inner(test_points, pad_to)
+            shards = handle[4]
+            if shards is not None:
+                sp.set(ndev=shards[0], t_loc=shards[2])
+            return handle
+
+    def _dispatch_flat_inner(self, test_points: np.ndarray,
+                             pad_to: int | None):
         inject.fire(sites.ENGINE_DISPATCH_FLAT)
         counts = self.index.counts_batch(test_points)
         tx_np = np.ascontiguousarray(np.asarray(test_points, np.int64))
@@ -1378,9 +1407,10 @@ class InfluenceEngine:
         except Exception:
             return None
         if self._cpu_engine is None:
-            print(
-                "[reliability] device-side recovery exhausted; "
-                "degrading to the CPU backend for this query"
+            obs.diag(
+                "reliability",
+                "device-side recovery exhausted; "
+                "degrading to the CPU backend for this query",
             )
             with jax.default_device(cpu0):
                 eng = InfluenceEngine(
@@ -1816,17 +1846,19 @@ class InfluenceEngine:
             inject.fire(sites.ENGINE_FACTOR_LOAD)
             bank, dropped = fbank.load_bank(path, self)
         except artifacts.ArtifactIntegrityError as e:
-            print(
-                f"[reliability] factor bank rejected ({e.reason}); "
-                "queries fall through the solver ladder"
+            obs.diag(
+                "reliability",
+                f"factor bank rejected ({e.reason}); "
+                "queries fall through the solver ladder",
             )
             return 0
         except Exception as e:
             if taxonomy.classify(e) is None:
                 raise
-            print(
-                "[reliability] factor bank load failed transiently; "
-                "serving without the bank"
+            obs.diag(
+                "reliability",
+                "factor bank load failed transiently; "
+                "serving without the bank",
             )
             return 0
         self._bank_dropped_stale = int(dropped)
@@ -1864,7 +1896,10 @@ class InfluenceEngine:
     def ensure_factor_bank(self) -> int:
         """Load the bank once, lazily; returns servable entry count."""
         if not self._bank_load_attempted:
-            self.load_factor_bank()
+            with obs.span("engine.bank_load") as sp:
+                self.load_factor_bank()
+                sp.set(entries=0 if self._bank is None
+                       else len(self._bank))
         return 0 if self._bank is None else len(self._bank)
 
     def unload_factor_bank(self) -> None:
@@ -2184,6 +2219,8 @@ class InfluenceEngine:
                 raise
             self._bank_hits -= len(points)
             self._bank_misses += len(points)
+            obs.REGISTRY.counter(
+                "engine.bank_hit_fallbacks").inc(len(points))
             return self._miss_delegate().query_batch(points, pad_to=pad_to)
 
     def _merge_stream(self, test_points, hits, misses,
@@ -2221,6 +2258,7 @@ class InfluenceEngine:
         T = test_points.shape[0]
         if not self._bank_serving_eligible():
             self._bank_misses += T
+            obs.REGISTRY.counter("engine.bank_misses").inc(T)
             return self._miss_delegate().query_batch(
                 test_points, pad_to=pad_to
             )
@@ -2233,6 +2271,9 @@ class InfluenceEngine:
         nh = int(np.count_nonzero(hit))
         self._bank_hits += nh
         self._bank_misses += T - nh
+        obs.REGISTRY.counter("engine.bank_hits").inc(nh)
+        obs.REGISTRY.counter("engine.bank_misses").inc(T - nh)
+        obs.event("bank.partition", hits=nh, misses=T - nh)
         if nh == T:
             return self._query_bank_hits(test_points, rows, pad_to)
         if nh == 0:
@@ -2270,10 +2311,21 @@ class InfluenceEngine:
         (``lissa → cg → direct``, ``schulz → direct``) and recomputes —
         see :meth:`_nan_ladder`.
         """
-        res = self._query_batch_impl(test_points, pad_to)
-        return self._nan_ladder(
-            res, lambda: self._query_batch_impl(test_points, pad_to)
-        )
+        t0 = time.perf_counter()
+        with obs.span("engine.query", solver_requested=self.solver) as sp:
+            res = self._query_batch_impl(test_points, pad_to)
+            res = self._nan_ladder(
+                res, lambda: self._query_batch_impl(test_points, pad_to)
+            )
+            # final attrs: the ladder may have escalated self.solver
+            sp.set(solver=self.solver, kernel=self._kernel_variant,
+                   n=int(np.asarray(test_points).reshape(-1, 2).shape[0]))
+        obs.REGISTRY.counter(
+            "engine.queries_total", solver=self.solver).inc()
+        obs.REGISTRY.histogram(
+            "engine.query_us", solver=self.solver
+        ).observe((time.perf_counter() - t0) * 1e6)
+        return res
 
     def _nan_ladder(self, res: InfluenceResult, recompute) -> InfluenceResult:
         """Escalate the solver until the payload is finite (or the
@@ -2289,16 +2341,24 @@ class InfluenceEngine:
         ) is not None:
             nxt = rpolicy.next_solver(self.solver)
             if nxt is None:
-                print(
-                    "[reliability] non-finite influence payload from the "
+                obs.diag(
+                    "reliability",
+                    "non-finite influence payload from the "
                     f"{self.solver!r} solver with no fallback rung left; "
-                    "returning as-is (check damping/conditioning)"
+                    "returning as-is (check damping/conditioning)",
                 )
                 return res
-            print(
-                "[reliability] non-finite influence payload from "
-                f"{self.solver!r}; escalating solver to {nxt!r}"
+            obs.diag(
+                "reliability",
+                "non-finite influence payload from "
+                f"{self.solver!r}; escalating solver to {nxt!r}",
             )
+            obs.event("solver.escalate",
+                      **{"from": self.solver, "to": nxt})
+            obs.REGISTRY.counter(
+                "engine.solver_escalations",
+                **{"from": self.solver, "to": nxt}
+            ).inc()
             self.solver = nxt
             self._jitted.clear()
             self._aot.clear()  # the solver is baked into AOT programs

@@ -27,6 +27,7 @@ import numpy as np
 from jax.flatten_util import ravel_pytree
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from fia_tpu import obs
 from fia_tpu.data.dataset import RatingDataset
 from fia_tpu.influence import solvers
 from fia_tpu.reliability import inject, sites
@@ -317,11 +318,17 @@ class FullInfluenceEngine:
                 return x
             nxt = rpolicy.next_solver(solver, rpolicy.FULL_SOLVER_FALLBACK)
             if nxt is None:
-                print(f"[reliability] {reason} from {solver!r} with no "
-                      "fallback rung left; returning as-is")
+                obs.diag("reliability",
+                         f"{reason} from {solver!r} with no "
+                         "fallback rung left; returning as-is")
                 return x
-            print(f"[reliability] {reason} from {solver!r}; escalating "
-                  f"solver to {nxt!r}")
+            obs.diag("reliability",
+                     f"{reason} from {solver!r}; escalating "
+                     f"solver to {nxt!r}")
+            obs.REGISTRY.counter(
+                "engine.solver_escalations",
+                **{"from": solver, "to": nxt}
+            ).inc()
             self.solver = solver = nxt
 
     @partial(jax.jit, static_argnums=0)

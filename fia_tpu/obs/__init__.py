@@ -1,0 +1,49 @@
+"""Unified observability spine: tracing + metrics + exporters.
+
+One instrument set for the whole serve→engine→solver pipeline
+(docs/observability.md):
+
+- :mod:`fia_tpu.obs.trace` — deterministic per-request spans
+  (contextvar-propagated, ids derived from request ids so chaos
+  golden-run byte contracts survive tracing being toggled).
+- :mod:`fia_tpu.obs.registry` — process-wide counters / gauges /
+  fixed-bucket µs histograms with a deterministic snapshot order.
+- :mod:`fia_tpu.obs.export` — JSONL span stream (superset-compatible
+  with the ``serve.*`` SCHEMA consumers), Chrome/Perfetto
+  ``trace_event`` JSON, Prometheus text exposition.
+- :mod:`fia_tpu.obs.diag` — the sanctioned replacement for bare
+  ``print`` diagnostics (lint rule FIA402): stderr + counter + span
+  event in one call.
+"""
+
+from fia_tpu.obs.diag import diag
+from fia_tpu.obs.registry import REGISTRY, Registry, get_registry
+from fia_tpu.obs.trace import (
+    TRACER,
+    Span,
+    Tracer,
+    configure,
+    current_span,
+    event,
+    span,
+    trace,
+    trace_id_for,
+    tracing_enabled,
+)
+
+__all__ = [
+    "REGISTRY",
+    "Registry",
+    "get_registry",
+    "TRACER",
+    "Span",
+    "Tracer",
+    "configure",
+    "current_span",
+    "diag",
+    "event",
+    "span",
+    "trace",
+    "trace_id_for",
+    "tracing_enabled",
+]

@@ -1,0 +1,29 @@
+"""Structured diagnostics: the sanctioned replacement for bare print.
+
+Lint rule FIA402 bans ``print(`` inside fia_tpu/ outside CLI mains;
+library code that needs a human-visible note calls :func:`diag`
+instead, which does three things at once so the note is never lost:
+
+- writes one ``[channel] message`` line to **stderr** (stdout stays
+  reserved for machine-readable CLI output),
+- bumps the ``diag_total{channel=...}`` counter in the obs registry,
+- attaches a span event to the current trace span, if any — so a
+  solver escalation shows up inside the very request that hit it.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from fia_tpu.obs.registry import REGISTRY
+from fia_tpu.obs.trace import TRACER
+
+
+def diag(channel: str, msg: str, **fields) -> None:
+    """One diagnostic: stderr line + counter + span event."""
+    REGISTRY.counter("diag_total", channel=channel).inc()
+    TRACER.current_span().event(f"diag.{channel}", msg=msg, **fields)
+    extra = ""
+    if fields:
+        extra = " " + " ".join(f"{k}={v}" for k, v in fields.items())
+    sys.stderr.write(f"[{channel}] {msg}{extra}\n")

@@ -1,0 +1,27 @@
+"""Declared obs event schema (JSONL surface).
+
+The obs spine adds two event kinds to the serving JSONL stream, a
+strict superset of the ``serve.*`` SCHEMA (fia_tpu/serve/metrics.py)
+so scripts/latency_report.py keeps working on mixed files. Lint rule
+FIA401 cross-checks every emit site under fia_tpu/serve/ against the
+union of both schemas, and every consumer (latency_report CONSUMES,
+cli/obs CONSUMES) against them — in both directions: an event
+declared here that no consumer reads is also a lint error. Keep this
+a literal dict (the linter reads it with ast.literal_eval).
+"""
+
+from __future__ import annotations
+
+SCHEMA = {
+    # one line per finished span, written by ServeMetrics.flush_obs()
+    # each drain: trace/span/parent are derived ids (obs/trace.py),
+    # t0 epoch-seconds, dur_us the span duration, attrs/events the
+    # span's key-value annotations and zero-duration markers
+    "obs.span": (
+        "trace", "span", "parent", "name", "t0", "dur_us",
+        "attrs", "events",
+    ),
+    # the registry snapshot (obs/registry.py Registry.snapshot()):
+    # written once on ServeMetrics.close() and on demand by bench
+    "obs.metrics": ("snapshot",),
+}

@@ -26,6 +26,8 @@ import numpy as np
 from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from fia_tpu import obs
+
 #: param names holding per-user/per-item rows, per model class name
 TABLE_PARAMS = {
     "MF": ("P", "Q", "bu", "bi"),
@@ -90,19 +92,30 @@ def shard_model_params(mesh: Mesh, params, model, axis: str = "model",
     names = table_names(model)
     parts = int(mesh.shape[axis])
     out = {}
-    for k, v in params.items():
-        if k in names:
-            if pad_rows:
-                pr = padded_rows(v.shape[0], parts)
-                if pr != int(v.shape[0]):
-                    v = jnp.pad(
-                        v, ((0, pr - int(v.shape[0])),)
-                        + ((0, 0),) * (v.ndim - 1)
-                    )
-            spec = P(axis, *([None] * (v.ndim - 1)))
-        else:
-            spec = P()
-        out[k] = put_global(mesh, v, spec)
+    with obs.span("parallel.shard_params", tables=len(names),
+                  parts=parts) as sp:
+        for k, v in params.items():
+            if k in names:
+                if pad_rows:
+                    pr = padded_rows(v.shape[0], parts)
+                    if pr != int(v.shape[0]):
+                        v = jnp.pad(
+                            v, ((0, pr - int(v.shape[0])),)
+                            + ((0, 0),) * (v.ndim - 1)
+                        )
+                spec = P(axis, *([None] * (v.ndim - 1)))
+            else:
+                spec = P()
+            out[k] = put_global(mesh, v, spec)
+        per_dev = per_device_table_bytes(out, model)
+        obs.REGISTRY.gauge("parallel.table_bytes_per_device").set(per_dev)
+        for k in names:
+            if k in out:
+                obs.REGISTRY.gauge(
+                    "parallel.table_bytes", table=k
+                ).set(int(np.prod(out[k].shape))
+                      * out[k].dtype.itemsize)
+        sp.set(per_device_bytes=per_dev)
     return out
 
 
@@ -132,6 +145,13 @@ def gather_table_rows(mesh: Mesh, model, params, uids, iids,
     names = table_names(model)
     row_axes = TABLE_ROW_AXES[type(model).__name__]
     tabs = tuple(params[n] for n in names)
+    # this runs at TRACE time when the caller is jitted, so no timing
+    # span here — count tracings instead (a recompile-storm indicator)
+    # and pin the event to whatever host span is open (precompile/query)
+    obs.REGISTRY.counter("parallel.gather_traces_total").inc()
+    obs.TRACER.current_span().event(
+        "parallel.gather_table_rows", tables=len(names)
+    )
     in_specs = (P("data", None), P("data", None)) + tuple(
         P(axis, *([None] * (t.ndim - 1))) for t in tabs
     )

@@ -50,6 +50,7 @@ import tempfile
 
 import numpy as np
 
+from fia_tpu import obs
 from fia_tpu.reliability import inject, sites
 from fia_tpu.reliability.journal import pack
 
@@ -253,8 +254,11 @@ def quarantine(path: str, reason: str = "") -> list[str]:
         os.replace(p, dst)
         moved.append(dst)
     if moved and reason:
-        print(f"[artifacts] quarantined {path} ({reason}) -> "
-              f"{', '.join(os.path.basename(m) for m in moved)}")
+        obs.diag(
+            "artifacts",
+            f"quarantined {path} ({reason}) -> "
+            f"{', '.join(os.path.basename(m) for m in moved)}",
+        )
     return moved
 
 

@@ -50,6 +50,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from fia_tpu import obs
 from fia_tpu.reliability import sites as _sites
 from fia_tpu.reliability import taxonomy
 
@@ -319,4 +320,4 @@ def active(*faults: Fault, strict: bool = False, validate: bool = False):
             )
             if strict and completed:
                 raise UnfiredFaultError(msg)
-            print(f"[inject] WARNING: {msg}")
+            obs.diag("inject", f"WARNING: {msg}")

@@ -32,6 +32,7 @@ import time as _time
 from dataclasses import dataclass
 from typing import Callable, Iterable
 
+from fia_tpu import obs
 from fia_tpu.reliability import taxonomy
 
 
@@ -191,6 +192,10 @@ class RetryPolicy:
                 d = self.delay(attempt)
                 if deadline is not None and deadline.remaining() < d:
                     raise
+                obs.REGISTRY.counter(
+                    "reliability.retries_total", kind=kind).inc()
+                obs.event("retry", kind=kind, attempt=attempt,
+                          delay_s=round(d, 3))
                 if on_retry is not None:
                     on_retry(kind, attempt, e)
                 if d > 0.0:

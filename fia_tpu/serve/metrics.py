@@ -41,6 +41,9 @@ from __future__ import annotations
 
 import numpy as np
 
+from fia_tpu.obs.export import span_fields
+from fia_tpu.obs.registry import REGISTRY
+from fia_tpu.obs.trace import TRACER
 from fia_tpu.serve.request import Response
 from fia_tpu.utils.logging import EventLog
 
@@ -128,6 +131,30 @@ class ServeMetrics:
         if resp.ok:
             self.queue_wait_ms.append(resp.queue_wait_s * 1e3)
             self.solve_ms.append(resp.solve_s * 1e3)
+        # mirror into the process-wide obs registry: the per-rung /
+        # per-mode µs histograms scripts/latency_report.py renders
+        # p50/p99 from (via the obs.metrics snapshot line)
+        mode = resp.mode or "none"
+        REGISTRY.counter(
+            "serve.requests_total", status=resp.status, mode=mode
+        ).inc()
+        if resp.reason:
+            REGISTRY.counter(
+                "serve.rejects_total", reason=resp.reason).inc()
+        if resp.ok:
+            solver = resp.extra.get("solver") or "none"
+            REGISTRY.histogram(
+                "serve.queue_wait_us", mode=mode
+            ).observe(resp.queue_wait_s * 1e6)
+            REGISTRY.histogram(
+                "serve.solve_by_mode_us", mode=mode
+            ).observe(resp.solve_s * 1e6)
+            REGISTRY.histogram(
+                "serve.solve_by_solver_us", solver=solver
+            ).observe(resp.solve_s * 1e6)
+            if resp.cache_tier:
+                REGISTRY.counter(
+                    "serve.tier_total", tier=resp.cache_tier).inc()
         self.log.log("serve.request", **resp.json(include_payload=False))
 
     def record_batch(self, batch_id: int, size: int, total_rows: int,
@@ -190,5 +217,18 @@ class ServeMetrics:
         self.log.log("serve.rollup", **r)
         return r
 
+    def flush_obs(self) -> None:
+        """Drain the tracer's finished spans into the JSONL stream
+        (one ``obs.span`` line each — obs/events.py SCHEMA). The
+        service calls this once per drain; a falsy metrics path makes
+        it a queue drain with no file writes."""
+        for sp in TRACER.flush():
+            self.log.log("obs.span", **span_fields(sp))
+
     def close(self) -> None:
+        self.flush_obs()
+        # final registry snapshot: the ``obs.metrics`` line the CLI's
+        # ``prom`` renderer and the latency report's histogram
+        # sections read (deterministic series order)
+        self.log.log("obs.metrics", snapshot=REGISTRY.snapshot())
         self.log.close()

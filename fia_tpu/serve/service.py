@@ -37,6 +37,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from fia_tpu import obs
 from fia_tpu.reliability import inject, sites, taxonomy
 from fia_tpu.serve.admission import (
     REASON_DEADLINE,
@@ -204,6 +205,9 @@ class InfluenceService:
         # per-drain health signals (classified failures / dispatches)
         self._drain_errors = 0
         self._drain_dispatches = 0
+        # drain counter: seeds the per-drain trace id (obs/trace.py) —
+        # deterministic across runs of the same request stream
+        self._drain_seq = 0
 
     # -- wiring ------------------------------------------------------------
     @classmethod
@@ -296,22 +300,26 @@ class InfluenceService:
                "hot_rekeyed": 0, "hot_dropped": 0,
                "disk_rekeyed": 0, "disk_dropped": 0}
         touched = getattr(footprint, "touched", footprint)
-        if touched is None:
-            if old is not None:
-                self.cache.invalidate()
-        elif old is not None and old[1] != new_fp:
-            hot = self.cache.rekey(old[1], new_fp, touched)
-            out["hot_rekeyed"] = hot["rekeyed"]
-            out["hot_dropped"] = hot["dropped"]
-            d = self._disk_dir(eng)
-            if d is not None:
-                disk = scache.disk_rekey(
-                    d, eng.model_name, eng.solver, old[1], new_fp,
-                    touched, stats=self.cache.stats,
-                )
-                out["disk_rekeyed"] = disk["rekeyed"]
-                out["disk_dropped"] = disk["dropped"]
+        with obs.span("stream.rekey",
+                      trace_seed=f"epoch-{self._epoch}") as sp:
+            if touched is None:
+                if old is not None:
+                    self.cache.invalidate()
+            elif old is not None and old[1] != new_fp:
+                hot = self.cache.rekey(old[1], new_fp, touched)
+                out["hot_rekeyed"] = hot["rekeyed"]
+                out["hot_dropped"] = hot["dropped"]
+                d = self._disk_dir(eng)
+                if d is not None:
+                    disk = scache.disk_rekey(
+                        d, eng.model_name, eng.solver, old[1], new_fp,
+                        touched, stats=self.cache.stats,
+                    )
+                    out["disk_rekeyed"] = disk["rekeyed"]
+                    out["disk_dropped"] = disk["dropped"]
+            sp.set(**out)
         self.metrics.record_swap(**out)
+        self.metrics.flush_obs()
         return out
 
     # -- request intake ----------------------------------------------------
@@ -332,6 +340,8 @@ class InfluenceService:
                 mode=self.health.mode,
             )
             self.metrics.record_request(resp)
+            self._trace_request(resp, self.clock())
+            self.metrics.flush_obs()
             return resp
         t = self.admission.ticket(req, self.clock())
         t.epoch = self._epoch
@@ -354,9 +364,30 @@ class InfluenceService:
         the live engine. The fence table is cleared afterwards: the
         service is synchronous, so the queue that referenced the old
         epochs is fully consumed here.
+
+        Span-only wrapper since the obs spine landed: the loop body
+        lives in ``_drain_impl`` (registered on the FIA204/205 dispatch
+        path in analysis/config.py); this level opens the drain trace,
+        rebuilds each resolved request's span chain, and flushes the
+        queued spans to the metrics JSONL. Tracing never touches the
+        responses themselves (byte identity vs tracing-off is pinned by
+        tests/test_obs.py).
         """
         if not self._queue:
             return []
+        self._drain_seq += 1
+        obs.REGISTRY.gauge("serve.queue_depth").set(len(self._queue))
+        with obs.trace(f"drain-{self._drain_seq}"):
+            with obs.span("serve.drain", n=len(self._queue)) as sp:
+                out = self._drain_impl()
+                sp.set(responses=len(out))
+        now = self.clock()
+        for r in out:
+            self._trace_request(r, now)
+        self.metrics.flush_obs()
+        return out
+
+    def _drain_impl(self) -> list[Response]:
         depth = len(self._queue)  # health signal: occupancy at drain start
         work, self._queue = self._queue, []
         now = self.clock()
@@ -392,7 +423,49 @@ class InfluenceService:
         )
         for tr in self.health.transitions[n0:]:
             self.metrics.record_mode(**tr)
+            obs.REGISTRY.counter(
+                "serve.mode_transitions",
+                **{"from": tr["from"], "to": tr["to"]}
+            ).inc()
+            obs.event("serve.mode_transition",
+                      **{"from": tr["from"], "to": tr["to"]})
         return out
+
+    def _trace_request(self, resp: Response, now: float) -> None:
+        """Rebuild one resolved request's span chain retroactively.
+
+        The drain loop already tracks every per-request latency
+        (queue_wait_s spans arrival→resolve, solve_s the batch
+        dispatch), so the chain is reconstructed at flush time instead
+        of threading span handles through the dispatch machinery. Ids
+        are derived from the request id (``trace_id_for(f"req-{id}")``)
+        — deterministic, and zero bytes change on the response. Chain
+        (seq): 0 serve.request (root) > 1 serve.admit, 2 serve.queue,
+        3 serve.batch > 4 serve.dispatch > 5 serve.solver.
+        """
+        if not obs.tracing_enabled():
+            return
+        tr = obs.TRACER
+        tid = obs.trace_id_for(f"req-{resp.id}")
+        t_res = now
+        t_arr = t_res - max(resp.queue_wait_s, 0.0)
+        t_disp = t_res - max(resp.solve_s, 0.0)
+        tr.record(
+            tid, "serve.request", t_arr, t_res, seq=0,
+            id=resp.id, user=int(resp.user), item=int(resp.item),
+            status=resp.status, reason=resp.reason, mode=resp.mode,
+        )
+        tr.record(tid, "serve.admit", t_arr, t_arr, seq=1, parent_seq=0)
+        tr.record(tid, "serve.queue", t_arr, t_disp, seq=2, parent_seq=0)
+        if not resp.ok:
+            return
+        tr.record(tid, "serve.batch", t_disp, t_res, seq=3, parent_seq=0,
+                  batch_id=resp.batch_id, batch_size=resp.batch_size)
+        tr.record(tid, "serve.dispatch", t_disp, t_res, seq=4,
+                  parent_seq=3, tier=resp.cache_tier)
+        tr.record(tid, "serve.solver", t_disp, t_res, seq=5,
+                  parent_seq=4, tier=resp.cache_tier,
+                  solver=resp.extra.get("solver"))
 
     def _resolve_group(self, eng, fp, live, responses) -> None:
         """Resolve one epoch group of live tickets against (eng, fp)."""
@@ -517,7 +590,9 @@ class InfluenceService:
                                      batch, bid, kind, t0)
                     continue
                 try:
-                    h = eng._dispatch_flat(bpts, None)
+                    with obs.span("serve.batch_dispatch", batch_id=bid,
+                                  size=len(batch)):
+                        h = eng._dispatch_flat(bpts, None)
                 except Exception as e:
                     kind = taxonomy.classify(e)
                     if kind is None:
@@ -551,12 +626,14 @@ class InfluenceService:
                 continue
             batch, bid, t0, h = inflight.pop(0)
             try:
-                res = eng._finalize_flat(h)
-                # same NaN screen query_batch applies: a non-finite
-                # payload walks the solver degradation ladder
-                res = eng._nan_ladder(
-                    res, lambda b=points[batch]: eng._query_batch_impl(b)
-                )
+                with obs.span("serve.batch_finalize", batch_id=bid):
+                    res = eng._finalize_flat(h)
+                    # same NaN screen query_batch applies: a non-finite
+                    # payload walks the solver degradation ladder
+                    res = eng._nan_ladder(
+                        res,
+                        lambda b=points[batch]: eng._query_batch_impl(b)
+                    )
             except Exception as e:
                 kind = taxonomy.classify(e)
                 if kind is None:
@@ -608,7 +685,9 @@ class InfluenceService:
         t0 = self.clock()
         try:
             inject.fire(sites.SERVE_DISPATCH)
-            res = eng.query_batch(points[batch])
+            with obs.span("serve.batch_dispatch", batch_id=bid,
+                          size=len(batch)):
+                res = eng.query_batch(points[batch])
         except Exception as e:
             kind = taxonomy.classify(e)
             if kind is None:
@@ -699,6 +778,10 @@ class InfluenceService:
             queue_wait_s=max(now - t.t_arrival, 0.0), solve_s=solve_s,
             batch_id=batch_id, batch_size=batch_size,
             mode=self.health.mode,
+            # solver provenance for the serve.solver span + per-rung
+            # histograms; extra never reaches Response.json(), so the
+            # wire bytes are unchanged (and identical trace-on/off)
+            extra={"solver": eng.solver},
         )
 
     def _reject(self, t: Ticket, reason: str, now: float, batch_id=None,
@@ -739,18 +822,26 @@ class InfluenceService:
         if new is None:
             return False
         try:
-            eng.rebuild_mesh(new)
-            if (eng.impl in ("auto", "flat") and eng._flat_eligible()
-                    and not eng._wide_block_cap() and not eng._multihost):
-                geoms = {tuple(eng.flat_geometry(np.asarray(p)))
-                         for p in pending_points if len(p)}
-                eng.precompile_flat(sorted(geoms))
+            seed = (f"device-loss-"
+                    f"{self.metrics.device_loss_recoveries}")
+            with obs.span("serve.device_loss_recovery",
+                          trace_seed=seed,
+                          ndev=int(new.devices.size)) as sp:
+                eng.rebuild_mesh(new)
+                if (eng.impl in ("auto", "flat") and eng._flat_eligible()
+                        and not eng._wide_block_cap()
+                        and not eng._multihost):
+                    geoms = {tuple(eng.flat_geometry(np.asarray(p)))
+                             for p in pending_points if len(p)}
+                    eng.precompile_flat(sorted(geoms))
+                    sp.set(rearmed=len(geoms))
         except Exception as e:
             if taxonomy.classify(e) is None:
                 raise
             return False
         self.mesh = new
         self.metrics.record_device_loss_recovery()
+        obs.REGISTRY.counter("serve.device_loss_recoveries").inc()
         return True
 
     def _disk_dir(self, eng) -> str | None:

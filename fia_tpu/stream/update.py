@@ -47,6 +47,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from fia_tpu import obs
 from fia_tpu.data.dataset import RatingDataset
 from fia_tpu.reliability import inject, sites, taxonomy
 from fia_tpu.stream.footprint import Footprint, compute_footprint
@@ -237,44 +238,51 @@ def apply_updates(model, new_x, new_y=None, steps: int = 100,
             # leak into the update (and must not vary across resumes)
             cfg.iter_to_switch_to_batch = None
             cfg.iter_to_switch_to_sgd = None
-            state = model._trainer.fit(
-                state, new_train.x, new_train.y,
-                num_steps=remaining, checkpointer=ck,
-            )
+            with obs.span("stream.fit", trace_seed=f"update-{uid}",
+                          update_id=uid, steps=remaining):
+                state = model._trainer.fit(
+                    state, new_train.x, new_train.y,
+                    num_steps=remaining, checkpointer=ck,
+                )
 
         # local-update projection: untouched blocks stay bit-identical
         old_host = model._host_params()
         new_host = jax.tree_util.tree_map(np.asarray, state.params)
-        projected = project_params(model.model, old_host, new_host,
-                                   footprint)
+        with obs.span("stream.project", trace_seed=f"update-{uid}",
+                      update_id=uid):
+            projected = project_params(model.model, old_host, new_host,
+                                       footprint)
         t_ready = clock.monotonic()
 
         inject.fire(sites.STREAM_SWAP)  # last no-mutation-yet fault point
         mutated = True
-        # fence first: each service pins its current (engine, fp) under
-        # the serving epoch so queued tickets keep answering on the
-        # state they were admitted against
-        services = list(model._serving)
-        for svc in services:
-            svc.pin_epoch()
-        model.state = TrainState(
-            jax.tree_util.tree_map(jnp.asarray, projected),
-            state.opt_state, target_step,
-        )
-        model.data_sets["train"] = new_train
-        model._engines.clear()
-        model.engine()  # new engine resident before any fence drops
-        model._refresh_factor_bank()  # surgical: dep_crc survivors re-keyed
-        for svc in services:
-            # hand over a WARM engine: pre-lower/compile the new
-            # engine's dispatch for the touched footprint while queued
-            # tickets still answer on the fenced old state — the first
-            # post-swap request must never pay a trace/compile. A
-            # warmup failure means the new engine cannot serve, so it
-            # (rightly) flows to the classified rollback below.
-            svc.warmup(nx[:1])
-        for svc in services:
-            svc.advance_epoch(footprint)
+        with obs.span("stream.fence_swap", trace_seed=f"update-{uid}",
+                      update_id=uid, services=len(model._serving)):
+            # fence first: each service pins its current (engine, fp)
+            # under the serving epoch so queued tickets keep answering
+            # on the state they were admitted against
+            services = list(model._serving)
+            for svc in services:
+                svc.pin_epoch()
+            model.state = TrainState(
+                jax.tree_util.tree_map(jnp.asarray, projected),
+                state.opt_state, target_step,
+            )
+            model.data_sets["train"] = new_train
+            model._engines.clear()
+            model.engine()  # new engine resident before any fence drops
+            model._refresh_factor_bank()  # dep_crc survivors re-keyed
+            for svc in services:
+                # hand over a WARM engine: pre-lower/compile the new
+                # engine's dispatch for the touched footprint while
+                # queued tickets still answer on the fenced old state —
+                # the first post-swap request must never pay a
+                # trace/compile. A warmup failure means the new engine
+                # cannot serve, so it (rightly) flows to the classified
+                # rollback below.
+                svc.warmup(nx[:1])
+            for svc in services:
+                svc.advance_epoch(footprint)
         staleness_s = clock.monotonic() - t_ready
         if ckpt_dir:
             shutil.rmtree(ckpt_dir, ignore_errors=True)

@@ -33,6 +33,7 @@ import re
 import jax
 import numpy as np
 
+from fia_tpu import obs
 from fia_tpu.reliability import artifacts, sites
 
 _GEN_RE = re.compile(r"^ckpt-(\d+)\.npz$")
@@ -197,18 +198,25 @@ def restore_latest_valid(dir_path: str, params_template, opt_template=None,
                        fingerprint=fingerprint, require_manifest=True)
         except artifacts.ArtifactIntegrityError as e:
             if verbose:
-                print(f"[artifacts] checkpoint {os.path.basename(path)} "
-                      f"rejected ({e.reason}); falling back to an older "
-                      "generation")
+                obs.diag(
+                    "artifacts",
+                    f"checkpoint {os.path.basename(path)} rejected "
+                    f"({e.reason}); falling back to an older generation",
+                )
             continue
         except ValueError as e:
             if verbose:
-                print(f"[artifacts] checkpoint {os.path.basename(path)} "
-                      f"skipped (template mismatch: {e})")
+                obs.diag(
+                    "artifacts",
+                    f"checkpoint {os.path.basename(path)} skipped "
+                    f"(template mismatch: {e})",
+                )
             continue
         if verbose:
-            print(f"[artifacts] restored step {step} from "
-                  f"{os.path.basename(path)}")
+            obs.diag(
+                "artifacts",
+                f"restored step {step} from {os.path.basename(path)}",
+            )
         return out
     return None
 

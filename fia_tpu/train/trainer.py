@@ -260,6 +260,7 @@ class Trainer:
             if checkpointer is not None:
                 checkpointer.maybe(params, opt_state, state.step + done)
             if cfg.log_every and ((epoch_i + 1) % max(1, cfg.log_every // nb) == 0):
+                # fialint: disable=FIA402 -- interactive step-progress stdout
                 print(f"step {state.step + done}: "
                       f"loss = {float(losses[r + todo - 1]):.6f}")
             if self.event_log is not None:

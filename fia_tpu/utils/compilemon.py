@@ -25,6 +25,9 @@ from __future__ import annotations
 
 from jax import monitoring as _monitoring
 
+from fia_tpu.obs.registry import REGISTRY
+from fia_tpu.obs.trace import TRACER
+
 # The per-backend-compile duration event (jax 0.4.x); one firing ==
 # one XLA compilation, whether reached through jit or AOT .compile().
 BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
@@ -36,6 +39,14 @@ _installed = False
 def _on_duration(event: str, duration: float, **kwargs) -> None:
     if event == BACKEND_COMPILE_EVENT:
         _counts["backend_compile"] += 1
+        # Mirror into the obs spine: the counter feeds compile-storm
+        # dashboards; the span event lands inside whatever stage was
+        # active (e.g. engine.precompile carries the AOT key), which
+        # is how a compile gets attributed to a request/key.
+        REGISTRY.counter("compile.backend_total").inc()
+        REGISTRY.histogram("compile.backend_us").observe(duration * 1e6)
+        TRACER.current_span().event(
+            "compile.backend", dur_us=round(duration * 1e6, 1))
 
 
 def install() -> None:

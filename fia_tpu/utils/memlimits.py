@@ -31,6 +31,8 @@ import hashlib
 import json
 import os
 
+from fia_tpu import obs
+
 _MAGIC = "fia-memlimits-v1"
 _SEAL = "__integrity__"
 
@@ -81,8 +83,10 @@ def _quarantine(path: str) -> None:
         dst = f"{path}.corrupt.{n}"
     try:
         os.replace(path, dst)
-        print(f"[memlimits] quarantined corrupt cache -> "
-              f"{os.path.basename(dst)}")
+        obs.diag(
+            "memlimits",
+            f"quarantined corrupt cache -> {os.path.basename(dst)}",
+        )
     except OSError:
         pass
 

@@ -13,6 +13,8 @@ import time
 
 import jax
 
+from fia_tpu.obs import trace as _obs_trace
+
 
 def fenced_time(fn, *args, **kwargs):
     """(result, seconds) with a device fence after fn."""
@@ -48,16 +50,21 @@ class Timer:
         def fence(self, value):
             return jax.block_until_ready(value)
 
-    def __init__(self):
+    def __init__(self, span_prefix: str = "timer"):
         self.sections: dict[str, float] = {}
+        # span name prefix when tracing is on: each timed section also
+        # becomes an obs span, so bench stage timers and serve spans
+        # report from one instrument set (docs/observability.md)
+        self.span_prefix = span_prefix
 
     @contextlib.contextmanager
     def __call__(self, name: str, fence: bool = False):
-        t0 = time.perf_counter()
-        yield Timer._Section()
-        if fence:
-            _flush_device_queue()
-        self.sections[name] = self.sections.get(name, 0.0) + time.perf_counter() - t0
+        with _obs_trace.span(f"{self.span_prefix}.{name}"):
+            t0 = time.perf_counter()
+            yield Timer._Section()
+            if fence:
+                _flush_device_queue()
+            self.sections[name] = self.sections.get(name, 0.0) + time.perf_counter() - t0
 
     def report(self) -> dict[str, float]:
         return dict(self.sections)

@@ -25,6 +25,9 @@ CONSUMES = {
                       "queue_wait_ms", "solve_ms"),
     "serve.batch": ("size", "solve_ms"),
     "serve.rollup": ("cache",),
+    # the final registry snapshot (fia_tpu/obs): per-solver-rung and
+    # per-serving-mode µs histograms rendered as p50/p99 below
+    "obs.metrics": ("snapshot",),
 }
 
 # The canonical rejection reasons (fia_tpu/serve/admission.py). The
@@ -44,6 +47,7 @@ def pcts(vals):
 
 def load(path: str):
     reqs, batches, rollups = [], [], []
+    snapshot = None
     with open(path) as fh:
         for line in fh:
             line = line.strip()
@@ -60,14 +64,55 @@ def load(path: str):
                 batches.append(d)
             elif ev == "serve.rollup":
                 rollups.append(d)
-    return reqs, batches, rollups
+            elif ev == "obs.metrics":
+                snapshot = d.get("snapshot")  # last one wins
+    return reqs, batches, rollups, snapshot
+
+
+def hist_pct(h: dict, buckets: list, q: float) -> float:
+    """Percentile (µs) from a snapshot-form fixed-bucket histogram by
+    linear interpolation inside the containing bucket — the inlined
+    twin of fia_tpu.obs.registry.percentile_from_snapshot (this script
+    stays importable without the package on the path)."""
+    count = int(h.get("count", 0))
+    if count == 0:
+        return 0.0
+    target = q / 100.0 * count
+    seen = 0
+    for i, c in enumerate(h["counts"]):
+        if seen + c >= target:
+            if i >= len(buckets):  # +inf bucket: clamp
+                return float(buckets[-1])
+            lo = buckets[i - 1] if i > 0 else 0.0
+            hi = buckets[i]
+            frac = (target - seen) / c if c else 0.0
+            return float(lo + (hi - lo) * frac)
+        seen += c
+    return float(buckets[-1])
+
+
+def print_hist_section(title: str, snapshot: dict, prefix: str) -> None:
+    """p50/p99 rows for every histogram series under ``prefix`` (e.g.
+    one row per solver rung / serving mode)."""
+    rows = [(k, h) for k, h in snapshot.get("histograms", {}).items()
+            if k.startswith(prefix)]
+    if not rows:
+        return
+    buckets = snapshot.get("buckets_us", [])
+    print(title)
+    for key, h in rows:
+        label = key.split("{", 1)[1][:-1] if "{" in key else key
+        p50 = hist_pct(h, buckets, 50) / 1e3
+        p99 = hist_pct(h, buckets, 99) / 1e3
+        print(f"  {label:<22} n={int(h['count']):<6} "
+              f"p50={p50:.2f}ms  p99={p99:.2f}ms")
 
 
 def main(argv) -> int:
     if len(argv) != 2:
         print(__doc__.strip(), file=sys.stderr)
         return 2
-    reqs, batches, rollups = load(argv[1])
+    reqs, batches, rollups, snapshot = load(argv[1])
     if not reqs and not rollups:
         print(f"no serving events in {argv[1]}", file=sys.stderr)
         return 1
@@ -119,6 +164,15 @@ def main(argv) -> int:
         if cache:
             print("cache: " + "  ".join(
                 f"{k}={cache[k]}" for k in sorted(cache)))
+    if snapshot:
+        # registry-histogram breakdowns (fia_tpu/obs): per solver rung
+        # and per serving mode, from the final obs.metrics snapshot
+        print_hist_section("solve by solver rung:", snapshot,
+                           "serve.solve_by_solver_us")
+        print_hist_section("solve by serving mode:", snapshot,
+                           "serve.solve_by_mode_us")
+        print_hist_section("queue wait by mode:", snapshot,
+                           "serve.queue_wait_us")
     return 0
 
 

@@ -1,0 +1,111 @@
+#!/usr/bin/env bash
+# Observability smoke (docs/observability.md), asserting on CPU:
+#   - a traced serve stream writes reconstructable span chains: every
+#     ok request carries admit→queue→batch→dispatch→solver under its
+#     serve.request root, rejected requests stop after serve.queue
+#     (`python -m fia_tpu.cli.obs report` exits nonzero on any break)
+#   - tracing is payload-invariant: scores with tracing ON are
+#     byte-identical to tracing OFF
+#   - the Perfetto and Prometheus exporters render the same stream
+#   - scripts/latency_report.py picks up the registry histograms
+#     (per-solver-rung / per-mode percentile sections)
+#
+#   bash scripts/obs_smoke.sh        (or: make obs-smoke)
+#
+# Budget: <30s on CPU — tiny synthetic problem, random-init params
+# (tracing invariance doesn't care about model quality), no training.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+DIR=$(mktemp -d /tmp/fia_obs_smoke.XXXXXX)
+trap 'rm -rf "$DIR"' EXIT
+
+JAX_PLATFORMS=cpu timeout -k 10 240 python - "$DIR" <<'PY'
+import sys
+
+import numpy as np
+import jax
+
+from fia_tpu import obs
+from fia_tpu.data.dataset import RatingDataset
+from fia_tpu.influence.engine import InfluenceEngine
+from fia_tpu.models import MF
+from fia_tpu.serve import InfluenceService, Request, ServeConfig
+
+out_dir = sys.argv[1]
+U, I, K = 60, 40, 4
+rng = np.random.default_rng(0)
+x = np.stack([rng.integers(0, U, 1500), rng.integers(0, I, 1500)],
+             axis=1).astype(np.int32)
+y = rng.integers(1, 6, 1500).astype(np.float32)
+train = RatingDataset(x, y)
+model = MF(U, I, K, 1e-3)
+params = model.init_params(jax.random.PRNGKey(0))
+pts = x[rng.choice(1500, 12, replace=False)].astype(np.int64)
+
+
+def serve(metrics_path):
+    eng = InfluenceEngine(model, params, train, damping=1e-3)
+    svc = InfluenceService(
+        engine=eng, config=ServeConfig(metrics_path=metrics_path))
+    out = []
+    for i, (u, it) in enumerate(pts):
+        svc.submit(Request(user=int(u), item=int(it), id=f"q{i}"))
+    # one invalid request (negative id is refused at the door, submit
+    # returns the rejection) exercises the short rejected span chain
+    out.append(svc.submit(Request(user=-1, item=0, id="bad")))
+    out.extend(svc.drain())
+    svc.close()
+    return out
+
+
+# A/B: tracing must not perturb payloads — byte-identical scores
+ref = serve(None)
+obs.REGISTRY.reset()  # snapshot below covers only the traced stream
+obs.configure(trace=True)
+got = serve(f"{out_dir}/serve.jsonl")
+obs.configure(trace=False)
+
+by_id = {r.id: r for r in ref}
+n_ok = 0
+for r in got:
+    b = by_id[r.id]
+    assert r.ok == b.ok, f"{r.id}: ok flipped under tracing"
+    if r.ok:
+        n_ok += 1
+        assert np.array_equal(np.asarray(r.scores), np.asarray(b.scores)), (
+            f"{r.id}: scores drift under tracing")
+assert n_ok == len(pts), f"expected {len(pts)} ok, got {n_ok}"
+rej = [r for r in got if not r.ok]
+assert len(rej) == 1 and rej[0].reason, "invalid request not rejected"
+print(f"obs-smoke serve: {n_ok} ok byte-identical trace-on/off, "
+      f"1 rejected ({rej[0].reason})")
+PY
+
+# The gate: chain completeness audit — exits nonzero on any ok request
+# missing a link of admit→queue→batch→dispatch→solver (or a rejected
+# one missing admit→queue), plus the registry summary.
+python -m fia_tpu.cli.obs report "$DIR/serve.jsonl"
+
+# Exporters render the same stream (Perfetto trace_event + Prometheus).
+python -m fia_tpu.cli.obs trace "$DIR/serve.jsonl" --last 8 \
+  --out "$DIR/trace.json"
+python - "$DIR/trace.json" <<'PY'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+evs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+assert evs, "perfetto export has no duration events"
+print(f"obs-smoke perfetto: {len(evs)} duration events")
+PY
+# Capture, then grep: `... | grep -q` closes the pipe at first match
+# and the writer dies of EPIPE under pipefail.
+python -m fia_tpu.cli.obs prom "$DIR/serve.jsonl" > "$DIR/prom.txt"
+grep -q '^serve_requests_total{' "$DIR/prom.txt" \
+  || { echo "prometheus export missing serve_requests_total"; exit 1; }
+
+# The human report picks up the registry histogram sections.
+python scripts/latency_report.py "$DIR/serve.jsonl" > "$DIR/report.txt"
+grep -q '^solve by solver rung:' "$DIR/report.txt" \
+  || { echo "latency report missing per-rung histogram section"; exit 1; }
+
+echo "obs-smoke PASS"

@@ -61,6 +61,14 @@ bash scripts/kernel_smoke.sh || {
   echo "kernel-smoke FAILED (run make kernel-smoke)"
   exit 1
 }
+# Obs smoke, FATAL: the tracing/metrics spine — traced serve stream
+# with complete per-request span chains, payloads byte-identical
+# trace-on/off, exporters rendering the same stream
+# (docs/observability.md).
+bash scripts/obs_smoke.sh || {
+  echo "obs-smoke FAILED (run make obs-smoke)"
+  exit 1
+}
 # Serving smoke next, NON-fatal: the pinned tier-1 verdict below stays
 # exactly the ROADMAP.md pytest command, the smoke just surfaces
 # serving regressions in the same log.

@@ -259,7 +259,8 @@ class TestInjector:
             inject.Fault("site.a", at=7, kind=taxonomy.WORKER)
         ):
             inject.fire("site.a")  # idx 0 only — at=7 never reached
-        out = capsys.readouterr().out
+        # diagnostics route through obs.diag, which writes stderr
+        out = capsys.readouterr().err
         assert "never fired" in out and "site.a@7:worker" in out
 
     def test_unfired_fault_strict_raises(self):
