@@ -1,7 +1,7 @@
 # Convenience targets. The commands themselves are pinned in
 # ROADMAP.md (tier-1) and scripts/ — these targets just name them.
 
-.PHONY: tier1 test lint lint-io serve-smoke multichip-smoke factor-smoke chaos-smoke chaos-soak churn-smoke degraded-smoke kernel-smoke scale-smoke obs-smoke
+.PHONY: tier1 test lint lint-io serve-smoke multichip-smoke factor-smoke chaos-smoke chaos-soak churn-smoke degraded-smoke approx-smoke kernel-smoke scale-smoke obs-smoke
 
 # The ROADMAP.md tier-1 verify: fast CPU suite, slow tests excluded.
 # Lint is fatal — a finding fails the build before pytest runs.
@@ -76,9 +76,18 @@ obs-smoke:
 # devices) — one forced device loss (4-device mesh shrinks to 3,
 # stream bit-identical to a single-device reference) and one brownout
 # episode (ladder to bank_preferred, bank hits byte-identical, misses
-# shed `degraded`, recovery to full). docs/design.md §18.
+# answered approx via the certified sampled rung, recovery to full).
+# docs/design.md §18.
 degraded-smoke:
 	bash scripts/degraded_smoke.sh
+
+# Approx smoke: the certified sampled rung on CPU (<60s) — per-query
+# error bounds honored vs the direct solver, batch-composition-
+# independent answers, tolerance escalation byte-identical to the next
+# ladder rung, and a brownout episode answering bank misses approx
+# with zero degraded sheds (docs/design.md §22).
+approx-smoke:
+	bash scripts/approx_smoke.sh
 
 # Scale smoke: row-sharded embedding tables on 8 virtual CPU devices
 # (<180s) — bit-identity vs the replicated engine at the 100k-user

@@ -222,9 +222,19 @@ def _solver_tier(model, params, train, damping) -> dict:
     out = {"bank_entries": int(len(bank)), "loaded": int(loaded),
            "queries": int(len(pts)), "lissa_depth": lissa_depth}
 
+    # sampled-rung cap small enough that the hot pairs' blocks really
+    # subsample (counts above it), so the timed row and the certificate
+    # gate below exercise the estimator, not its exact m==n degeneracy
+    sampled_cap = 16
     tiers = {}
     res_by_tier = {}
     for tier, eng_t in (("precomputed", eng),
+                        ("sampled", InfluenceEngine(
+                            model, params, train, damping=damping,
+                            solver="sampled", cache_dir=None,
+                            model_name=name, pad_bucket=512,
+                            lissa_depth=lissa_depth,
+                            sampled_cap=sampled_cap)),
                         ("lissa_miss_path", mk("lissa", cache=False)),
                         ("direct", mk("direct", cache=False))):
         tp = pts
@@ -254,6 +264,38 @@ def _solver_tier(model, params, train, damping) -> dict:
             for t in range(len(pts))]
     out["spearman_vs_direct_min"] = round(float(min(rhos)), 6)
     out["spearman_vs_direct_median"] = round(float(np.median(rhos)), 6)
+
+    # certificate fidelity gate (docs/design.md §22): on this fixed-seed
+    # query set, |sampled − direct| must sit within the stamped
+    # per-query bound on ≥99% of queries — the concentration bound is
+    # 3σ, so a run below the gate means the certificate math regressed,
+    # not that the sampler was unlucky
+    res_s = res_by_tier["sampled"]
+    within = 0
+    worst_ratio = 0.0
+    for t in range(len(pts)):
+        diff = float(np.max(np.abs(
+            res_s.scores_of(t) - res_by_tier["direct"].scores_of(t)
+        ))) if int(res_s.counts[t]) else 0.0
+        eb = float(res_s.err_bound[t])
+        within += int(diff <= eb + 1e-9)
+        if eb > 0:
+            worst_ratio = max(worst_ratio, diff / eb)
+    frac = within / len(pts)
+    out["sampled_certificate"] = {
+        "cap": sampled_cap,
+        "queries": int(len(pts)),
+        "within_bound_frac": round(frac, 4),
+        "worst_diff_over_bound": round(worst_ratio, 4),
+        "err_bound_max": round(float(res_s.err_bound.max()), 6),
+        "gate_99pct": bool(frac >= 0.99),
+    }
+    _stage(f"sampled certificate: {within}/{len(pts)} within bound "
+           f"(gate {'PASS' if frac >= 0.99 else 'FAIL'})")
+    assert frac >= 0.99, (
+        f"sampled-rung certificate violated on {len(pts) - within}/"
+        f"{len(pts)} queries — bound math regressed"
+    )
 
     # mixed half-banked stream: half the banked set plus an equal count
     # of never-banked held-out pairs, so the partition + merge path and
@@ -339,6 +381,99 @@ def _serve_multidevice(model, params, train, pool, damping) -> dict:
         "steady_state_compiles": steady,
         "ok": sum(1 for r in got if r.ok),
         "bitwise_mismatches_vs_single_device": mismatched,
+    }
+
+
+def _serve_brownout(model, params, train, pool, damping) -> dict:
+    """Forced ``full → bank_preferred`` brownout episode (docs/design.md
+    §22): one synthetic over-threshold health signal drives the ladder
+    down — identical in both runs, so the episodes are comparable
+    byte-for-byte — then a mixed hit/miss wave serves. Miss-path
+    answers must come back ``approx=True`` with a stamped bound and
+    ZERO ``degraded`` rejections, while the exact-path responses
+    (cache hits) stay byte-identical to the same episode with approx
+    serving disabled (``HealthConfig.approx_ok=False``), where the
+    misses shed ``degraded`` instead."""
+    from fia_tpu.influence.engine import InfluenceEngine
+    from fia_tpu.serve import InfluenceService, Request, ServeConfig
+    from fia_tpu.serve.health import HealthConfig
+
+    hot = [tuple(int(v) for v in p) for p in pool[:4]]
+    cold = [tuple(int(v) for v in p) for p in pool[4:10]]
+
+    class _TickClock:
+        """Deterministic monotonic stand-in: identical request streams
+        produce identical latency stamps, so the exact-path responses
+        of the two runs can be compared byte-for-byte."""
+
+        def __init__(self):
+            self.t = 0.0
+
+        def __call__(self):
+            self.t += 1e-3
+            return self.t
+
+    def run(approx_ok: bool):
+        eng = InfluenceEngine(model, params, train, damping=damping,
+                              solver="direct")
+        svc = InfluenceService(engine=eng, clock=_TickClock(),
+                               config=ServeConfig(
+            max_batch=8, disk_cache=False,
+            health=HealthConfig(window=4, err_degrade=0.5,
+                                err_cache_only=2.0, err_recover=0.25,
+                                min_evidence=2, queue_hold=3, hold=8,
+                                approx_ok=approx_ok),
+        ))
+        # warm the hot set (cache hits keep serving through brownout)
+        svc.run([Request(u, i, id=f"w{j}")
+                 for j, (u, i) in enumerate(hot)])
+        # one over-threshold evidence window steps the ladder to
+        # bank_preferred (the controller only consumes this signal,
+        # so the forcing is deterministic and identical in both runs)
+        svc.health.observe(errors=8, dispatches=8, queue_depth=0,
+                           queue_cap=svc.admission.max_queue)
+        assert svc.health.mode == "bank_preferred", svc.health.mode
+        # the brownout wave: warmed hits + fresh misses
+        wave = [Request(u, i, id=f"h{j}")
+                for j, (u, i) in enumerate(hot)]
+        wave += [Request(u, i, id=f"m{j}")
+                 for j, (u, i) in enumerate(cold)]
+        resp = svc.run(wave)
+        return svc.rollup(), {r.id: r for r in resp}
+
+    roll_a, resp_a = run(True)
+    roll_b, resp_b = run(False)
+
+    miss_a = [r for rid, r in resp_a.items() if rid.startswith("m")]
+    assert all(r.ok and r.approx and r.err_bound is not None
+               for r in miss_a), "brownout miss not certified-approx"
+    assert roll_a["rejected"].get("degraded", 0) == 0, roll_a["rejected"]
+    assert roll_b["rejected"].get("degraded", 0) == len(cold), \
+        roll_b["rejected"]
+    # exact-path byte identity: every non-approx response of the approx
+    # run must be bit-identical to its twin in the approx-off run
+    mismatched = 0
+    for rid, r in resp_a.items():
+        if r.approx:
+            continue
+        twin = resp_b[rid]
+        same = (r.json(include_payload=False)
+                == twin.json(include_payload=False))
+        if same and r.ok:
+            same = np.array_equal(r.scores, twin.scores)
+        mismatched += int(not same)
+    assert mismatched == 0, \
+        f"{mismatched} exact-path responses changed under approx serving"
+    return {
+        "mode": "bank_preferred",
+        "approx_answers": roll_a["answered_approx"],
+        "miss_wave": len(cold),
+        "degraded_rejections": roll_a["rejected"].get("degraded", 0),
+        "degraded_rejections_approx_off": roll_b["rejected"].get(
+            "degraded", 0),
+        "err_bound_max": max(
+            (float(r.err_bound) for r in miss_a), default=0.0),
+        "exact_path_mismatches": mismatched,
     }
 
 
@@ -1117,6 +1252,15 @@ def serve_main():
         _stage(f"multi-device serve stage FAILED: {e!r}")
         multi_device = {"error": repr(e)}
 
+    # forced brownout episode: misses answer certified-approximate
+    # instead of shedding, exact path byte-identical to approx-off
+    _stage("brownout approx episode (forced bank_preferred)")
+    brownout_approx = _serve_brownout(model, state.params, train, pool,
+                                      damping)
+    _stage(f"brownout approx: {brownout_approx['approx_answers']} "
+           f"approx answers, "
+           f"{brownout_approx['degraded_rejections']} degraded")
+
     unreasoned = sum(1 for r in responses if not r.ok and not r.reason)
     from fia_tpu.serve import (
         REASON_DEADLINE,
@@ -1133,6 +1277,19 @@ def serve_main():
         for r in (REASON_OVERLOAD, REASON_INVALID, REASON_DEADLINE,
                   REASON_DEGRADED)
     }
+    # certified-approx accounting: every finished request is exactly
+    # one of rejected / answered-exact / answered-approx — the shed
+    # counters and the approx counter partition the stream with no
+    # double-counting
+    answered_approx = roll["answered_approx"]
+    answered_exact = roll["ok"] - answered_approx
+    rejected_total = sum(roll["rejected"].values())
+    assert (rejected_total + answered_exact + answered_approx
+            == roll["requests"]), (
+        f"serve accounting leak: {rejected_total} rejected + "
+        f"{answered_exact} exact + {answered_approx} approx != "
+        f"{roll['requests']} admitted"
+    )
     out = {
         "metric": "fia-serve sustained qps (open loop @1.2x capacity)",
         "value": round(roll["ok"] / wall, 2),
@@ -1143,6 +1300,8 @@ def serve_main():
             "offered_qps": round(offered_qps, 2),
             "requests": n_req,
             "ok": roll["ok"],
+            "answered_exact": answered_exact,
+            "answered_approx": answered_approx,
             "rejected": roll["rejected"],
             "rejected_by_reason": rejected_by_reason,
             "modes": roll["modes"],
@@ -1155,6 +1314,7 @@ def serve_main():
             "mean_batch_size": roll["mean_batch_size"],
             "wall_s": round(wall, 2),
             "multi_device": multi_device,
+            "brownout_approx": brownout_approx,
         },
     }
     assert unreasoned == 0, "serving dropped requests without a reason"

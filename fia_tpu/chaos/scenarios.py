@@ -799,8 +799,12 @@ class FactorBankScenario(Scenario):
         self.ref_bank = [
             self._one(self.eng, p).tobytes() for p in self.pairs
         ]
+        # the rung a rejected bank degrades to — since the certified
+        # rung landed that is ``sampled``, not lissa (bitwise-exact
+        # here: toy counts sit below the default sample cap)
         ladder = InfluenceEngine(
-            self.model, params, train, damping=_DAMP, solver="lissa",
+            self.model, params, train, damping=_DAMP,
+            solver=rpolicy.next_solver("precomputed"),
             model_name="chaos-factor", lissa_depth=30,
             kernel="xla_analytic")
         self.ref_ladder = [
@@ -1104,6 +1108,229 @@ class UpdateWhileServingScenario(Scenario):
         return failures
 
 
+class ServeBrownoutScenario(Scenario):
+    """Certified-approximate serving through a forced brownout episode
+    (docs/design.md §22, docs/reliability.md "Degraded modes").
+
+    Wave A serves four cold misses in ``full`` mode — real dispatches
+    plus disk-tier publishes (the damage point; no wave-A key is ever
+    re-read, so benign damage is invisible to the outcome). A synthetic
+    sick-backend drain signal is then fed to the health controller —
+    deterministic, identical in golden and chaos runs — forcing
+    ``full → bank_preferred``. Wave B mixes two banked pairs (exact
+    O(1) bank hits) with four unbanked misses that must be ANSWERED
+    from the certified ``sampled`` rung, ``approx=True`` with a stamped
+    error bound, instead of shed ``degraded``.
+
+    The scenario oracle (``certified_approx_integrity``) holds every
+    approx answer to its own certificate: |served − direct reference|
+    must stay within the stamped bound. A transient fault at
+    ``engine.sampled_solve`` escalates the whole sampled micro-batch
+    one ladder rung — those answers must then byte-match the
+    escalation-rung reference (computed per micro-batch, since the
+    fallback re-solves the batch verbatim) and drop the approx stamp.
+    Either way, an in-bounds query is never rejected ``degraded``.
+    """
+
+    name = "serve_brownout"
+    MAX_BATCH = 3
+    NWARM, NBANK, NAPPROX = 4, 2, 4
+    SAMPLED_CAP = 16  # < typical block count: genuinely subsampled
+    # wave-A misses publish 4 disk entries; approx answers never
+    # publish, so damage is bounded by the exact-path dispatches
+    benign_domain = {
+        sites.SERVE_CACHE_PUBLISH: (_DAMAGE_KINDS, 4),
+    }
+    # 2 guaranteed sampled dispatches (NAPPROX=4 misses, micro-batches
+    # of MAX_BATCH=3): the fire seam's call index is the dispatch
+    # ordinal, and an escalated batch still lets the next one dispatch
+    full_domain = {
+        sites.SERVE_CACHE_PUBLISH: (_DAMAGE_KINDS, 4),
+        sites.ENGINE_SAMPLED_SOLVE: (_TRANSIENT_KINDS, 2),
+        sites.CHAOS_SCENARIO: ((taxonomy.WORKER,), 1),
+    }
+
+    def __init__(self):
+        import tempfile
+
+        import jax
+
+        from fia_tpu.data.dataset import RatingDataset
+        from fia_tpu.influence import factor as fbank
+        from fia_tpu.influence.engine import InfluenceEngine
+        from fia_tpu.models import MF
+
+        x, y = _toy_data(3, 400)
+        self.model = MF(_U, _I, _K, _WD)
+        params = self.model.init_params(jax.random.PRNGKey(0))
+        train = RatingDataset(x, y)
+        self.eng = InfluenceEngine(
+            self.model, params, train, damping=_DAMP,
+            solver="precomputed", cache_dir=tempfile.mkdtemp(
+                prefix="fia-chaos-brownout-init-"),
+            model_name="chaos-brownout", lissa_depth=30,
+            kernel="xla_analytic", sampled_cap=self.SAMPLED_CAP)
+        pairs = fbank.select_hot_pairs(
+            self.eng.index, max_entries=self.NBANK + 2,
+            top_users=4, top_items=4)
+        self.bank = fbank.build_bank(self.eng, pairs)
+        self.fp = fbank.bank_fingerprint(
+            "chaos-brownout", self.model.block_size, _DAMP,
+            *self.eng._train_host)
+        banked = {(int(u), int(i)) for u, i in pairs}
+        self.bank_pairs = sorted(banked)[: self.NBANK]
+        fresh: list = []
+        for u, i in zip(x[:, 0], x[:, 1]):
+            p = (int(u), int(i))
+            if p not in banked and p not in fresh:
+                fresh.append(p)
+        self.warm_pairs = fresh[: self.NWARM]
+        self.approx_pairs = fresh[self.NWARM: self.NWARM + self.NAPPROX]
+
+        # fault-free references for the oracle: the exact answer each
+        # certificate bounds against, and the escalation-rung bytes a
+        # fault-escalated sampled batch must reproduce — per service
+        # micro-batch, because escalation re-solves the batch verbatim
+        direct = InfluenceEngine(
+            self.model, params, train, damping=_DAMP, solver="direct",
+            model_name="chaos-brownout", kernel="xla_analytic")
+        ladder = InfluenceEngine(
+            self.model, params, train, damping=_DAMP,
+            solver=rpolicy.next_solver("sampled") or "direct",
+            model_name="chaos-brownout", lissa_depth=30,
+            kernel="xla_analytic")
+        self.ref_direct: dict = {}
+        self.ref_ladder: dict = {}
+        for lo in range(0, self.NAPPROX, self.MAX_BATCH):
+            chunk = self.approx_pairs[lo: lo + self.MAX_BATCH]
+            pts = np.asarray(chunk, np.int64)
+            res_d = direct.query_batch(pts)
+            res_l = ladder.query_batch(pts)
+            for t, p in enumerate(chunk):
+                self.ref_direct[p] = np.asarray(
+                    res_d.scores_of(t)).copy()
+                self.ref_ladder[p] = np.asarray(
+                    res_l.scores_of(t)).tobytes()
+
+    def run(self, workdir: str, events: list) -> dict:
+        from fia_tpu.influence import factor as fbank
+        from fia_tpu.serve.health import MODE_BANK_PREFERRED, HealthConfig
+        from fia_tpu.serve.request import Request
+        from fia_tpu.serve.service import InfluenceService, ServeConfig
+
+        eng = self.eng
+        eng.cache_dir = os.path.join(workdir, "cache")
+        eng.unload_factor_bank()
+        eng.solver = "precomputed"  # undo any sticky prior escalation
+        path = fbank.default_bank_path(eng.cache_dir, eng.model_name)
+        fbank.publish_bank(self.bank, path, self.fp)
+        if eng.ensure_factor_bank() == 0:
+            raise RuntimeError("serve_brownout: factor bank not loaded")
+        svc = InfluenceService(
+            engine=eng,
+            config=ServeConfig(
+                max_batch=self.MAX_BATCH,
+                health=HealthConfig(
+                    window=4, err_degrade=0.5, err_cache_only=2.0,
+                    err_recover=0.25, min_evidence=2, queue_hold=3,
+                    hold=8),
+            ),
+            clock=rpolicy.VirtualClock(),
+        )
+        # wave A: cold misses in full mode (dispatches + publishes)
+        for j, p in enumerate(self.warm_pairs):
+            svc.submit(Request(*p, id=f"w{j}"))
+        res_a = svc.drain()
+        # the forced episode: one synthetic sick-backend drain signal —
+        # min_evidence=2 is met and the windowed error rate crosses
+        # err_degrade, so the ladder steps to bank_preferred; hold=8
+        # keeps it there for the remainder of the run
+        svc.health.observe(errors=8, dispatches=8, queue_depth=0,
+                           queue_cap=svc.admission.max_queue)
+        if svc.health.mode != MODE_BANK_PREFERRED:
+            raise RuntimeError(
+                f"forced brownout did not engage ({svc.health.mode})")
+        events.append({"event": "brownout_forced",
+                       "mode": svc.health.mode})
+        # wave B: bank hits + unbanked misses under bank_preferred
+        for j, p in enumerate(self.bank_pairs):
+            svc.submit(Request(*p, id=f"b{j}"))
+        for j, p in enumerate(self.approx_pairs):
+            svc.submit(Request(*p, id=f"a{j}"))
+        res_b = svc.drain()
+
+        out: dict = {"mode": svc.health.mode}
+        for r in res_a + res_b:
+            out[f"{r.id}:status"] = f"{r.status}/{r.reason or ''}"
+            out[f"{r.id}:approx"] = int(bool(r.approx))
+            out[f"{r.id}:err"] = (float(r.err_bound)
+                                  if r.err_bound is not None else -1.0)
+            if r.ok:
+                out[f"{r.id}:scores"] = np.asarray(r.scores).copy()
+        roll = svc.rollup()
+        out["answered_approx"] = int(roll["answered_approx"])
+        out["rejected_degraded"] = int(
+            roll["rejected"].get("degraded", 0))
+        events.append({"event": "serve_rollup",
+                       "answered_approx": int(roll["answered_approx"]),
+                       "modes": roll["modes"]})
+        return out
+
+    def check(self, golden: dict, record) -> list:
+        from fia_tpu.chaos.oracles import OracleFailure
+
+        if record.error is not None or record.outcome is None:
+            return []
+        got = record.outcome
+        failures = []
+        for j, p in enumerate(self.approx_pairs):
+            rid = f"a{j}"
+            status = str(got.get(f"{rid}:status", "<missing>"))
+            if status != "ok/":
+                failures.append(OracleFailure(
+                    "certified_approx_integrity",
+                    f"{rid}: in-bounds brownout miss not answered "
+                    f"(got {status}) — certified approx serving must "
+                    "replace the degraded shed",
+                ))
+                continue
+            scores = np.asarray(got[f"{rid}:scores"])
+            if got.get(f"{rid}:approx"):
+                eb = float(got.get(f"{rid}:err", -1.0))
+                ref = self.ref_direct[p]
+                diff = (float(np.max(np.abs(scores - ref)))
+                        if scores.size else 0.0)
+                if eb < 0.0:
+                    failures.append(OracleFailure(
+                        "certified_approx_integrity",
+                        f"{rid}: approx answer with no stamped "
+                        "err_bound",
+                    ))
+                elif diff > eb + 1e-6:
+                    failures.append(OracleFailure(
+                        "certified_approx_integrity",
+                        f"{rid}: served score error {diff:.3e} exceeds "
+                        f"the stamped certificate {eb:.3e}",
+                    ))
+            elif scores.tobytes() != self.ref_ladder[p]:
+                # a sampled-solve fault escalates the whole micro-batch
+                # one rung; an un-stamped answer matching neither
+                # reference is the silent-wrong-answer class
+                failures.append(OracleFailure(
+                    "certified_approx_integrity",
+                    f"{rid}: un-stamped answer does not byte-match the "
+                    "escalation-rung reference (silent wrong answer)",
+                ))
+        degraded = int(got.get("rejected_degraded", 0))
+        if degraded:
+            failures.append(OracleFailure(
+                "certified_approx_integrity",
+                f"{degraded} request(s) shed 'degraded' while the "
+                "sampled rung was allowed to answer them",
+            ))
+        return failures
+
+
 def make_scenarios() -> dict:
     """Fresh scenario registry (instances are lazily constructed so the
     selftest path never imports jax)."""
@@ -1117,6 +1344,7 @@ def make_scenarios() -> dict:
         DeviceLossRecoveryScenario.name: DeviceLossRecoveryScenario,
         FactorBankScenario.name: FactorBankScenario,
         UpdateWhileServingScenario.name: UpdateWhileServingScenario,
+        ServeBrownoutScenario.name: ServeBrownoutScenario,
     }
 
 

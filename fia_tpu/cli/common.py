@@ -66,7 +66,16 @@ def base_parser(desc: str) -> argparse.ArgumentParser:
                    help="force a JAX platform (default: auto)")
     p.add_argument("--solver", type=str, default="direct",
                    choices=["direct", "cg", "lissa", "schulz",
-                            "precomputed"])
+                            "precomputed", "sampled"])
+    p.add_argument("--sampled_cap", type=int, default=None,
+                   help="sampled-rung Hessian sample cap per query "
+                        "(docs/design.md §22; default: the engine's "
+                        "DEFAULT_CAP). Queries with fewer related rows "
+                        "are exact (err_bound 0)")
+    p.add_argument("--sampled_tol", type=float, default=None,
+                   help="sampled-rung certificate tolerance: queries "
+                        "whose err_bound exceeds it escalate one ladder "
+                        "rung (default: inf — always serve sampled)")
     p.add_argument("--cg_maxiter", type=int, default=100,
                    help="CG iteration cap (reference fmin_ncg maxiter, "
                         "matrix_factorization.py:431)")
@@ -159,7 +168,7 @@ def engine_kwargs(args) -> dict:
     solver means."""
     from fia_tpu.reliability.policy import resolve_solver
 
-    return dict(
+    kw = dict(
         damping=args.damping,
         solver=resolve_solver(args.solver),
         pad_policy=args.pad_policy,
@@ -170,6 +179,11 @@ def engine_kwargs(args) -> dict:
         impl=args.impl,
         shard_tables=getattr(args, "model_parallel", 1) > 1,
     )
+    if getattr(args, "sampled_cap", None) is not None:
+        kw["sampled_cap"] = args.sampled_cap
+    if getattr(args, "sampled_tol", None) is not None:
+        kw["sampled_tol"] = args.sampled_tol
+    return kw
 
 
 def mesh_for(args):

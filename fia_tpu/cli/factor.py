@@ -142,9 +142,12 @@ def run_verify(engine, args, name, summary) -> int:
             None,
         )
         if miss is not None:
+            from fia_tpu.reliability import policy as rpolicy
+
             mq = np.asarray([miss], np.int64)
             a = eng.query_batch(mq).scores_of(0)
-            b = mk("lissa", cache=False).query_batch(mq).scores_of(0)
+            b = mk(rpolicy.next_solver("precomputed") or "direct",
+                   cache=False).query_batch(mq).scores_of(0)
             if not np.array_equal(a, b):
                 failures.append("miss fall-through not bitwise-identical "
                                 "to the bank-less ladder")

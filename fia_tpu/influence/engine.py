@@ -38,6 +38,7 @@ from fia_tpu.data.index import InteractionIndex, bucketed_pad
 from fia_tpu.influence import grads as G
 from fia_tpu.influence import hvp as H
 from fia_tpu.influence import kernels as K
+from fia_tpu.influence import sampled as sampled_mod
 from fia_tpu.influence import solvers
 from fia_tpu.influence import spectral
 from fia_tpu.reliability import inject, sites, taxonomy
@@ -58,10 +59,19 @@ class InfluenceResult:
 
     def __init__(self, scores=None, related_idx=None, related_mask=None,
                  counts=None, ihvp=None, test_grad=None,
-                 packed=None, test_points=None, index=None, pad=None):
+                 packed=None, test_points=None, index=None, pad=None,
+                 err_bound=None, approx=False):
         self.counts = counts
         self.ihvp = ihvp
         self.test_grad = test_grad
+        # Certified-approximate payloads (solver='sampled', docs/design
+        # §22): err_bound is a (T,) per-query bound on the max per-row
+        # score error (0 for exactly-solved queries), approx marks a
+        # result carrying at least one subsampled answer. None/False on
+        # every exact path, so downstream consumers can treat absence
+        # as exactness.
+        self.err_bound = None if err_bound is None else np.asarray(err_bound)
+        self.approx = bool(approx)
         self._scores = scores
         self._related_idx = related_idx
         self._related_mask = related_mask
@@ -155,6 +165,16 @@ def _concat_results(parts: list["InfluenceResult"]) -> "InfluenceResult":
     counts = np.concatenate([p.counts for p in parts])
     ihvp = np.concatenate([p.ihvp for p in parts])
     test_grad = np.concatenate([p.test_grad for p in parts])
+    # error bounds stitch like every other per-query array; parts
+    # without one are exact (bound 0)
+    err = None
+    if any(p.err_bound is not None for p in parts):
+        err = np.concatenate([
+            p.err_bound if p.err_bound is not None
+            else np.zeros(len(p.counts), np.float32)
+            for p in parts
+        ])
+    approx = any(p.approx for p in parts)
     if parts[0]._packed is not None:
         return InfluenceResult(
             counts=counts,
@@ -164,6 +184,8 @@ def _concat_results(parts: list["InfluenceResult"]) -> "InfluenceResult":
             test_points=np.concatenate([p._test_points for p in parts]),
             index=parts[0]._index,
             pad=max(p._pad for p in parts),
+            err_bound=err,
+            approx=approx,
         )
     return InfluenceResult(
         np.concatenate([p.scores for p in parts]),
@@ -172,6 +194,8 @@ def _concat_results(parts: list["InfluenceResult"]) -> "InfluenceResult":
         counts,
         ihvp,
         test_grad,
+        err_bound=err,
+        approx=approx,
     )
 
 
@@ -185,8 +209,12 @@ class InfluenceEngine:
       damping: Hessian damping λ (reference default 1e-6, RQ1.py:20).
       solver: 'direct' (materialise + LU solve; exact, TPU-fast default),
         'cg' (matrix-free, fmin_ncg-equivalent on this quadratic),
-        'lissa', or 'schulz' (matmul-only Newton–Schulz inversion,
-        beyond-reference option).
+        'lissa', 'schulz' (matmul-only Newton–Schulz inversion,
+        beyond-reference option), 'precomputed' (factor-bank tier), or
+        'sampled' (certified subsampled rung: Hessian over at most
+        ``sampled_cap`` related rows per query, answers stamped with a
+        concentration error bound, over-tolerance queries escalated one
+        ladder rung — docs/design.md §22).
       mesh: optional jax Mesh with a 'data' axis; query batches are then
         sharded across it. With a 2-D ('data', 'model') mesh, pass
         ``shard_tables=True`` to row-shard the embedding tables over the
@@ -222,8 +250,11 @@ class InfluenceEngine:
         query_bucket: int = 64,
         kernel: str = "auto",
         lissa_tune: str = "spectral",
+        sampled_cap: int = sampled_mod.DEFAULT_CAP,
+        sampled_tol: float = float("inf"),
     ):
-        if solver not in ("direct", "cg", "lissa", "schulz", "precomputed"):
+        if solver not in ("direct", "cg", "lissa", "schulz",
+                          "precomputed", "sampled"):
             raise ValueError(f"unknown solver {solver!r}")
         self.model = model
         # Score-kernel variant for the flat/bank paths (influence/kernels/):
@@ -432,6 +463,16 @@ class InfluenceEngine:
         self._bank_hits = 0
         self._bank_misses = 0
         self._bank_delegate: "InfluenceEngine | None" = None
+        # Certified subsampled rung (solver='sampled', docs/design.md
+        # §22): Hessian accumulation over <= sampled_cap related rows
+        # per query (the score pass still covers every row), answers
+        # stamped with a concentration error bound. Queries whose bound
+        # exceeds sampled_tol escalate one ladder rung through a
+        # config-identical delegate — the adaptive cost/accuracy policy.
+        self.sampled_cap = max(1, int(sampled_cap))
+        self.sampled_tol = float(sampled_tol)
+        self._sampled_delegate: "InfluenceEngine | None" = None
+        self._approx_sibling: "InfluenceEngine | None" = None
 
     def _upload_device_state(self) -> None:
         """(Re)build every device-resident tensor from host copies.
@@ -1432,6 +1473,8 @@ class InfluenceEngine:
                     # the CPU rung ('auto' resolves it there)
                     kernel="auto" if self.kernel == "pallas" else self.kernel,
                     lissa_tune=self.lissa_tune,
+                    sampled_cap=self.sampled_cap,
+                    sampled_tol=self.sampled_tol,
                 )
                 eng._is_cpu_fallback = True
             self._cpu_engine = eng
@@ -1579,6 +1622,9 @@ class InfluenceEngine:
             "ihvp": np.asarray(res.ihvp),
             "test_grad": np.asarray(res.test_grad),
         }
+        if res.err_bound is not None:
+            base["err_bound"] = np.asarray(res.err_bound)
+            base["approx"] = np.asarray(res.approx)
         if res._packed is not None:
             base.update(
                 fmt="packed",
@@ -1596,16 +1642,20 @@ class InfluenceEngine:
         return base
 
     def _result_from_journal(self, p: dict) -> InfluenceResult:
+        err = p["err_bound"] if "err_bound" in p else None
+        approx = bool(np.asarray(p["approx"])) if "approx" in p else False
         if p["fmt"] == "packed":
             return InfluenceResult(
                 counts=p["counts"], ihvp=p["ihvp"],
                 test_grad=p["test_grad"], packed=p["packed"],
                 test_points=p["test_points"], index=self.index,
                 pad=int(p["pad"]),
+                err_bound=err, approx=approx,
             )
         return InfluenceResult(
             p["scores"], p["related_idx"], p["related_mask"],
             p["counts"], p["ihvp"], p["test_grad"],
+            err_bound=err, approx=approx,
         )
 
     def _assemble_packed(self, test_points, counts, out, pad: int,
@@ -1965,6 +2015,8 @@ class InfluenceEngine:
                 query_bucket=self.query_bucket,
                 kernel=self.kernel,
                 lissa_tune=self.lissa_tune,
+                sampled_cap=self.sampled_cap,
+                sampled_tol=self.sampled_tol,
             )
         return self._bank_delegate
 
@@ -2236,17 +2288,24 @@ class InfluenceEngine:
             [[0], np.cumsum(counts.astype(np.int64))]
         )
         packed = np.zeros(int(off[-1]), np.float32)
+        # sub-results from the sampled rung carry per-query bounds;
+        # positions from exact sub-results keep bound 0
+        approx = any(res.approx for _, res in (hits, misses))
+        err = np.zeros(T, np.float32) if approx else None
         for idxs, res in (hits, misses):
             for r, tpos in enumerate(idxs):
                 packed[off[tpos]: off[tpos + 1]] = res.scores_of(r)
                 ihvp[tpos] = res.ihvp[r]
                 tg[tpos] = res.test_grad[r]
+                if err is not None and res.err_bound is not None:
+                    err[tpos] = res.err_bound[r]
         pad = bucketed_pad(
             counts.max() if counts.size else 1, self.pad_bucket, pad_to
         )
         return InfluenceResult(
             counts=counts, ihvp=ihvp, test_grad=tg, packed=packed,
             test_points=np.asarray(test_points), index=self.index, pad=pad,
+            err_bound=err, approx=approx,
         )
 
     def _query_precomputed(self, test_points: np.ndarray,
@@ -2288,6 +2347,372 @@ class InfluenceEngine:
         )
         return self._merge_stream(test_points, (hi, res_h), (mi, res_m),
                                   pad_to)
+
+    # -- certified subsampled rung (solver='sampled') ----------------------
+    def _sampled_eligible(self) -> bool:
+        """The sampled program is the single-device flat body with a
+        Horvitz–Thompson-weighted Hessian accumulation; mesh engines
+        escalate one rung through the delegate rather than grow a third
+        sharded program family (the rung exists to serve cheap bounded
+        answers, which a mesh-size batch does not need)."""
+        return (
+            self.mesh is None
+            and not self.group_queries
+            and self.hessian_mode != "autodiff"
+            and self.pad_policy == "batch"
+            and self.model.block_cross_const is not None
+            and self.model.block_reg_diag is not None
+        )
+
+    def _sampled_fn(self, s_pad: int):
+        """Fused subsampled query program (docs/design.md §22).
+
+        The flat body (``_flat_fn``) with the Hessian accumulated over
+        the host-sampled row subset only — ``ws`` carries the ``n/m``
+        Horvitz–Thompson weights, 0 off-sample — while the score pass
+        still covers EVERY related row, plus the per-query
+        concentration certificate (influence/sampled.py). At
+        ``m == n`` the weights are all 1 and the program is bitwise the
+        exact flat program with a zero bound. Outputs
+        ``(scores, ihvp, v, err_bound)``.
+        """
+        use_feat = self._rowfeat is not None
+        variant = self._kernel_variant
+        key = ("sampled", s_pad, use_feat, variant)
+        if key in self._jitted:
+            return self._jitted[key]
+        import math
+
+        model = self.model
+        prelude = self._flat_prelude(s_pad)
+        d = model.block_size
+        chunk = math.gcd(s_pad, self.flat_chunk)
+
+        def fn(params, train_x, train_y, postings, tx, rowfeat, ws, msz):
+            T = tx.shape[0]
+            u, i, counts, t, row, wv, ut, it = prelude(tx, postings)
+
+            if use_feat:
+                feat = rowfeat[row]
+                g, e, ma, mb = model.grads_from_row_features(feat, ut, it)
+                ab = wv * ma * mb
+                rel_x = train_x[row] if variant == "pallas" else None
+            else:
+                rel_x = train_x[row]
+                rel_y = train_y[row]
+                g = K.row_grads(model, variant, params, ut, it, rel_x)
+                e = model.predict(params, rel_x) - rel_y
+                ab = wv * (rel_x[:, 0] == ut) * (rel_x[:, 1] == it)
+
+            onehot = self.flat_accum == "onehot" or (
+                self.flat_accum == "auto"
+                and jax.default_backend() == "tpu"
+            )
+
+            def accum(g_r, t_r, w_r, abe_r):
+                def body_scatter(carry, args):
+                    acc, s_abe = carry
+                    gc, tc, wc, ac = args
+                    outer = (gc * wc[:, None])[:, :, None] * gc[:, None, :]
+                    return (acc.at[tc].add(outer),
+                            s_abe.at[tc].add(ac)), None
+
+                def body_onehot(carry, args):
+                    acc, s_abe = carry
+                    gc, tc, wc, ac = args
+                    oh = (
+                        tc[:, None]
+                        == jnp.arange(T, dtype=tc.dtype)[None, :]
+                    ).astype(jnp.float32)
+                    outer = (
+                        (gc * wc[:, None])[:, :, None] * gc[:, None, :]
+                    ).reshape(-1, d * d)
+                    Hc = jax.lax.dot_general(
+                        oh, outer,
+                        (((0,), (0,)), ((), ())),
+                        precision=jax.lax.Precision.HIGHEST,
+                    )
+                    return (
+                        acc + Hc.reshape(T, d, d),
+                        s_abe + jnp.sum(oh * ac[:, None], axis=0),
+                    ), None
+
+                (acc, s_abe), _ = jax.lax.scan(
+                    body_onehot if onehot else body_scatter,
+                    (jnp.zeros((T, d, d), jnp.float32),
+                     jnp.zeros((T,), jnp.float32)),
+                    (g_r, t_r, w_r, abe_r),
+                )
+                return acc, s_abe
+
+            nc = s_pad // chunk
+            # the ONLY divergence from the flat body: sample weights on
+            # both Hessian terms (wv folds into ws — off-sample rows
+            # carry 0 — so E[H_m] = H and m == n is bitwise exact)
+            HH, sum_abe = accum(
+                g.reshape(nc, chunk, d), t.reshape(nc, chunk),
+                (wv * ws).reshape(nc, chunk),
+                (ab * ws * e).reshape(nc, chunk),
+            )
+            n_t = jnp.maximum(counts.astype(jnp.float32), 1.0)
+            C = model.block_cross_const(params)
+            rdiag = model.block_reg_diag(params)
+            H = (2.0 / n_t)[:, None, None] * (
+                HH + sum_abe[:, None, None] * C[None]
+            ) + jnp.diag(rdiag + self.damping)[None]
+
+            v = jax.vmap(
+                lambda uu, ii, xj: G.block_prediction_grad(
+                    model, params, uu, ii, xj[None, :]
+                )
+            )(u, i, tx)
+            ihvp = jax.vmap(solvers.solve_direct)(H, v)
+
+            theta = jax.vmap(
+                lambda uu, ii: model.flatten_block(
+                    model.extract_block(params, uu, ii)
+                )
+            )(u, i)
+            reg_dot = jnp.sum(theta * rdiag[None] * ihvp, axis=1)
+            scores = K.fused_scores(
+                model, variant, params, ut, it, t, rel_x, e, wv,
+                ihvp, reg_dot, n_t, g=g,
+            )
+
+            # certificate: sample deviation of the per-row Hessian
+            # action h_s(x) on the solved vector, pushed through the
+            # inverse and the score form (influence/sampled.py)
+            gx = jnp.einsum("sd,sd->s", g, ihvp[t])
+            Cx = ihvp @ C.T
+            h = wv[:, None] * g * gx[:, None] + (ab * e)[:, None] * Cx[t]
+            sigma = sampled_mod.segment_sample_std(h, ws, t, msz, T)
+            # λ_min(H_m) in place of the raw damping floor: the GN part
+            # contributes real positive curvature, and the measured
+            # spectrum tightens the bound by the same factor (d is the
+            # tiny block size, so the batched eigvalsh is a rounding
+            # error next to the accumulation)
+            lam = jnp.maximum(
+                jnp.linalg.eigvalsh(H)[:, 0], self.damping
+            )
+            err_ihvp = sampled_mod.ihvp_error_bound(
+                sigma, msz, counts, lam
+            )
+            gnorm = jnp.sqrt(jnp.sum(g * g, axis=1))
+            # segment maxima clamp at 0: an empty segment (a pair with
+            # no postings) yields -inf, and its bound must read 0
+            gmax = jnp.maximum(
+                jax.ops.segment_max(wv * 2.0 * jnp.abs(e) * gnorm, t, T),
+                0.0,
+            )
+            wmax = jnp.maximum(jax.ops.segment_max(wv, t, T), 0.0)
+            regnorm = jnp.sqrt(jnp.sum((theta * rdiag[None]) ** 2, axis=1))
+            err = sampled_mod.score_error_bound(
+                gmax, wmax, regnorm, err_ihvp, n_t
+            )
+            return scores, ihvp, v, err
+
+        self._jitted[key] = jax.jit(fn)
+        return self._jitted[key]
+
+    def _sampled_fallback(self) -> "InfluenceEngine":
+        """Escalation target of the sampled rung: a config-identical
+        engine one ladder rung down (``sampled → lissa``), shared
+        across batches so its compiled programs amortize."""
+        if self._sampled_delegate is None:
+            self._sampled_delegate = InfluenceEngine(
+                self.model,
+                self._params_host,
+                RatingDataset(*self._train_host),
+                damping=self.damping,
+                solver=rpolicy.next_solver("sampled") or "direct",
+                cg_maxiter=self.cg_maxiter,
+                cg_tol=self.cg_tol,
+                lissa_scale=self.lissa_scale,
+                lissa_depth=self.lissa_depth,
+                mesh=self.mesh,
+                cache_dir=None,
+                model_name=self.model_name,
+                pad_bucket=self.pad_bucket,
+                shard_tables=self._shard_tables,
+                hessian_mode=self.hessian_mode,
+                group_queries=self.group_queries,
+                pad_policy=self.pad_policy,
+                impl=self.impl,
+                flat_chunk=self.flat_chunk,
+                flat_accum=self.flat_accum,
+                row_features=self.row_features,
+                cpu_fallback=self.cpu_fallback,
+                query_bucket=self.query_bucket,
+                kernel=self.kernel,
+                lissa_tune=self.lissa_tune,
+            )
+        return self._sampled_delegate
+
+    def approx_sibling(self) -> "InfluenceEngine":
+        """A config-identical engine at the ``sampled`` rung, cache-less.
+
+        The serving brownout path (serve/service.py) answers
+        ``bank_preferred`` misses from this sibling instead of shedding
+        them: same model state, same knobs, ``solver='sampled'`` and no
+        disk cache — so a certified approximate answer can never be
+        written under (or read from) the exact solver's cache keys.
+        Shared per engine so the sibling's compiled programs amortize
+        across brownout episodes; an engine already on the sampled rung
+        is its own sibling.
+        """
+        if self.solver == "sampled":
+            return self
+        if self._approx_sibling is None:
+            self._approx_sibling = InfluenceEngine(
+                self.model,
+                self._params_host,
+                RatingDataset(*self._train_host),
+                damping=self.damping,
+                solver="sampled",
+                cg_maxiter=self.cg_maxiter,
+                cg_tol=self.cg_tol,
+                lissa_scale=self.lissa_scale,
+                lissa_depth=self.lissa_depth,
+                mesh=self.mesh,
+                cache_dir=None,
+                model_name=self.model_name,
+                pad_bucket=self.pad_bucket,
+                shard_tables=self._shard_tables,
+                hessian_mode=self.hessian_mode,
+                group_queries=self.group_queries,
+                pad_policy=self.pad_policy,
+                impl=self.impl,
+                flat_chunk=self.flat_chunk,
+                flat_accum=self.flat_accum,
+                row_features=self.row_features,
+                cpu_fallback=self.cpu_fallback,
+                query_bucket=self.query_bucket,
+                kernel=self.kernel,
+                lissa_tune=self.lissa_tune,
+                sampled_cap=self.sampled_cap,
+                sampled_tol=self.sampled_tol,
+            )
+        return self._approx_sibling
+
+    def _result_take(self, res: InfluenceResult, idxs: np.ndarray,
+                     test_points: np.ndarray) -> InfluenceResult:
+        """Restrict a packed result to the query positions ``idxs``
+        (stream order preserved) — the sampled rung keeps the
+        in-tolerance slice of a batch while escalated queries recompute."""
+        off = res._offsets
+        packed = (
+            np.concatenate(
+                [res._packed[off[t]: off[t + 1]] for t in idxs]
+            )
+            if len(idxs)
+            else np.zeros(0, np.float32)
+        )
+        return InfluenceResult(
+            counts=res.counts[idxs], ihvp=res.ihvp[idxs],
+            test_grad=res.test_grad[idxs], packed=packed,
+            test_points=np.asarray(test_points)[idxs], index=self.index,
+            pad=res._pad,
+            err_bound=None if res.err_bound is None
+            else res.err_bound[idxs],
+            approx=res.approx,
+        )
+
+    def _query_sampled(self, test_points: np.ndarray,
+                       pad_to: int | None) -> InfluenceResult:
+        """The ``sampled`` rung: one fused subsampled dispatch for the
+        whole batch; queries whose certificate exceeds ``sampled_tol``
+        escalate one ladder rung (docs/design.md §22) — the per-query
+        cost/accuracy policy."""
+        T = test_points.shape[0]
+        if not self._sampled_eligible():
+            obs.REGISTRY.counter(
+                "engine.sampled_escalations", reason="ineligible"
+            ).inc(T)
+            return self._sampled_fallback().query_batch(
+                test_points, pad_to=pad_to
+            )
+        try:
+            res = self._dispatch_sampled(test_points, pad_to)
+        except Exception as e:
+            cls = _classify_device_failure(e)
+            if cls is None:
+                raise
+            # one-shot degradation on any classified device fault: the
+            # fallback engine owns the full retry/CPU ladder
+            obs.REGISTRY.counter(
+                "engine.sampled_escalations", reason=cls
+            ).inc(T)
+            self._reset_device_state()
+            return self._sampled_fallback().query_batch(
+                test_points, pad_to=pad_to
+            )
+        err = res.err_bound
+        over = np.flatnonzero(err > self.sampled_tol)
+        obs.REGISTRY.counter("engine.sampled_queries").inc(T)
+        obs.event("engine.sampled", queries=T, escalated=int(len(over)),
+                  err_max=float(err.max()) if T else 0.0)
+        if len(over) == 0:
+            return res
+        obs.REGISTRY.counter(
+            "engine.sampled_escalations", reason="tolerance"
+        ).inc(int(len(over)))
+        res_e = self._sampled_fallback().query_batch(
+            test_points[over], pad_to=pad_to
+        )
+        keep = np.flatnonzero(err <= self.sampled_tol)
+        if len(keep) == 0:
+            return res_e
+        sub = self._result_take(res, keep, test_points)
+        return self._merge_stream(test_points, (keep, sub),
+                                  (over, res_e), pad_to)
+
+    def _dispatch_sampled(self, test_points: np.ndarray,
+                          pad_to: int | None) -> InfluenceResult:
+        inject.fire(sites.ENGINE_SAMPLED_SOLVE)
+        counts = self.index.counts_batch(test_points)
+        tx_np = np.ascontiguousarray(np.asarray(test_points, np.int64))
+        T = tx_np.shape[0]
+        pad = bucketed_pad(
+            counts.max() if counts.size else 1, self.pad_bucket, pad_to
+        )
+        t_pad = self._query_pad(T)
+        if t_pad > T:
+            # same trailing-pair padding as _dispatch_flat: pad rows'
+            # flat positions land past the real total
+            tx_np = np.concatenate(
+                [tx_np, np.repeat(tx_np[-1:], t_pad - T, axis=0)]
+            )
+        pcounts = (self.index.counts_batch(tx_np)
+                   if t_pad > T else counts)
+        s_pad = self._s_pad_for(int(pcounts.sum()))
+        # deterministic per-(u, i) Philox sample, batch-composition
+        # independent: the same pair always serves the same answer/bound
+        ws_np, m_np = sampled_mod.sample_weights(
+            tx_np, pcounts, s_pad, self.sampled_cap
+        )
+        with obs.span("engine.dispatch_sampled", n=int(T)):
+            out = self._sampled_fn(s_pad)(
+                self.params, self.train_x, self.train_y, self._postings,
+                jnp.asarray(tx_np, jnp.int32), self._rowfeat,
+                jnp.asarray(ws_np), jnp.asarray(m_np),
+            )
+            packed, ihvp, v, err = jax.device_get(out)
+        # same payload seam as the exact path (sites.ENGINE_SOLVE, the
+        # fetched iHVP host buffer) — engine.sampled_solve stays a pure
+        # raise seam so its call index is the dispatch ordinal, which
+        # chaos schedules rely on
+        ihvp = inject.corrupt(sites.ENGINE_SOLVE, np.asarray(ihvp)[:T])
+        return InfluenceResult(
+            counts=counts,
+            ihvp=ihvp,
+            test_grad=np.asarray(v)[:T],
+            packed=np.asarray(packed)[: int(counts.sum())],
+            test_points=np.asarray(test_points),
+            index=self.index,
+            pad=pad,
+            err_bound=np.asarray(err)[:T],
+            approx=True,
+        )
 
     # -- public API --------------------------------------------------------
     def query_batch(
@@ -2377,6 +2802,9 @@ class InfluenceEngine:
 
         if self.solver == "precomputed":
             return self._query_precomputed(test_points, pad_to)
+
+        if self.solver == "sampled":
+            return self._query_sampled(test_points, pad_to)
 
         if self.impl in ("auto", "flat") and self._flat_eligible():
             if self._wide_block_cap() and T > 32:

@@ -211,9 +211,15 @@ class RetryPolicy:
 # ``precomputed`` sits ahead of the ladder: a bank hit is one
 # triangular-solve/matvec, and ANY trouble — missing bank entry, stale
 # fingerprint, damaged artifact, NaN payload — falls through to the
-# estimated rungs, which serve the query from scratch.
-QUERY_SOLVER_FALLBACK = {"precomputed": "lissa", "lissa": "cg",
-                         "schulz": "direct", "cg": "direct"}
+# estimated rungs, which serve the query from scratch. ``sampled`` is
+# the certified-approximate rung between the bank and lissa: a
+# subsampled block-Hessian iHVP whose answer carries an explicit error
+# bound (docs/design.md §22); queries whose certificate misses the
+# tolerance escalate one rung, so the ladder doubles as a per-query
+# cost/accuracy policy.
+QUERY_SOLVER_FALLBACK = {"precomputed": "sampled", "sampled": "lissa",
+                         "lissa": "cg", "schulz": "direct",
+                         "cg": "direct"}
 FULL_SOLVER_FALLBACK = {"lissa": "cg"}
 
 
@@ -226,9 +232,11 @@ def next_solver(
 
 
 # Solver names each engine accepts (ladder-ordered robust-last). The
-# full-parameter engine has no block bank, so ``precomputed`` requested
-# there walks the ladder down to ``lissa`` via resolve_solver.
-BLOCK_SOLVERS = ("precomputed", "lissa", "schulz", "cg", "direct")
+# full-parameter engine has no block bank and no subsampled block
+# estimator, so ``precomputed`` or ``sampled`` requested there walks
+# the ladder down to ``lissa`` via resolve_solver.
+BLOCK_SOLVERS = ("precomputed", "sampled", "lissa", "schulz", "cg",
+                 "direct")
 FULL_SOLVERS = ("lissa", "cg")
 
 

@@ -32,6 +32,7 @@ ENGINE_UPLOAD = "engine.upload"
 ENGINE_DISPATCH_FLAT = "engine.dispatch_flat"
 ENGINE_DISPATCH_PADDED = "engine.dispatch_padded"
 ENGINE_SOLVE = "engine.solve"
+ENGINE_SAMPLED_SOLVE = "engine.sampled_solve"
 ENGINE_CACHE_PUBLISH = "engine.cache_publish"
 ENGINE_FACTOR_LOAD = "engine.factor_load"
 
@@ -72,6 +73,7 @@ ALL_SITES = frozenset({
     ENGINE_DISPATCH_FLAT,
     ENGINE_DISPATCH_PADDED,
     ENGINE_SOLVE,
+    ENGINE_SAMPLED_SOLVE,
     ENGINE_CACHE_PUBLISH,
     ENGINE_FACTOR_LOAD,
     FACTOR_PUBLISH,

@@ -213,11 +213,19 @@ def disk_get(path: str, fingerprint: dict,
             stats.disk_rejects += 1
         return None
     try:
+        # certificate provenance (certified-approximate entries — an
+        # engine on the 'sampled' rung): round-trip the stamped bound
+        # so a disk hit cannot launder an approximate block into an
+        # exact-looking response
+        extra = {}
+        if "err_bound" in d and bool(np.asarray(d.get("approx", 0))):
+            extra = {"approx": True, "err_bound": float(d["err_bound"])}
         return BlockEntry(
             scores=np.asarray(d["scores"]),
             ihvp=np.asarray(d["ihvp"]),
             test_grad=np.asarray(d["test_grad"]),
             count=int(d["count"]),
+            extra=extra,
         ).freeze()
     except KeyError:
         if stats is not None:
@@ -290,14 +298,20 @@ def disk_put(path: str, entry: BlockEntry, fingerprint: dict) -> None:
     """
     from fia_tpu.reliability import artifacts
 
+    payload = dict(
+        scores=np.asarray(entry.scores),
+        ihvp=np.asarray(entry.ihvp),
+        test_grad=np.asarray(entry.test_grad),
+        count=np.asarray(entry.count, np.int64),
+    )
+    if entry.extra.get("approx"):
+        payload["approx"] = np.asarray(1, np.int64)
+        payload["err_bound"] = np.asarray(
+            entry.extra["err_bound"], np.float64
+        )
     artifacts.publish_npz(
         path,
-        dict(
-            scores=np.asarray(entry.scores),
-            ihvp=np.asarray(entry.ihvp),
-            test_grad=np.asarray(entry.test_grad),
-            count=np.asarray(entry.count, np.int64),
-        ),
+        payload,
         fingerprint=fingerprint,
         site=sites.SERVE_CACHE_PUBLISH,
     )

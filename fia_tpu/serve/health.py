@@ -12,8 +12,13 @@ Modes (severity order)::
 
     full            everything serves (the healthy steady state)
     bank_preferred  cache hits + precomputed-bank hits serve; misses
-                    that would need a ladder solve are shed "degraded"
+                    that would need a ladder solve serve from the
+                    certified ``sampled`` rung instead — answered
+                    ``approx=True`` with a stamped error bound — when
+                    ``approx_ok`` allows it, and are shed "degraded"
+                    otherwise
     cache_only      only hot/disk cache hits serve; every miss is shed
+                    (the one mode where "degraded" rejections remain)
 
 The :class:`HealthController` drives the mode from two windowed
 signals observed once per drain:
@@ -83,6 +88,10 @@ class HealthConfig:
     # intended, a queue pinned full across drains is overload
     queue_hold: int = 3
     hold: int = 2              # consecutive calm samples per rung up
+    # degraded modes may answer misses from the certified sampled rung
+    # (approx=True + err_bound) instead of shedding them "degraded";
+    # False restores the PR-10 shed-everything brownout
+    approx_ok: bool = True
 
     def validate(self) -> "HealthConfig":
         if self.window < 1 or self.hold < 1:
@@ -201,3 +210,10 @@ class HealthController:
     def allows_bank(self) -> bool:
         """May a miss take the O(1) precomputed-bank path?"""
         return self.mode in (MODE_FULL, MODE_BANK_PREFERRED)
+
+    def allows_approx(self) -> bool:
+        """May a brownout miss serve a certified approximate answer
+        (the ``sampled`` rung) instead of shedding? ``cache_only`` is
+        the exhaustion floor — by then the backend is failing most
+        dispatches and even a subsampled solve is work it cannot do."""
+        return self.config.approx_ok and self.mode == MODE_BANK_PREFERRED

@@ -9,7 +9,14 @@ record carries ``t``, a wall-clock epoch-seconds stamp):
      "item": i, "status": "ok"|"rejected", "reason": null|"deadline"|
      "overload"|"invalid"|<taxonomy kind>, "tier": null|"hot"|"disk"|
      "compute", "queue_wait_ms": f, "solve_ms": f,
-     "batch_id": n|null, "batch_size": n|null}
+     "batch_id": n|null, "batch_size": n|null,
+     "approx": bool, "err_bound": f|null}
+
+``approx``/``err_bound`` are the certified-approximate stamp
+(docs/design.md §22): True marks an answer served from the subsampled
+``sampled`` rung (a brownout miss, or any dispatch on a
+solver='sampled' engine) and ``err_bound`` carries its concentration
+bound on the per-row score error. Exact answers log ``false``/null.
 
 ``serve.batch`` — one line per micro-batch dispatch::
 
@@ -25,7 +32,7 @@ record carries ``t``, a wall-clock epoch-seconds stamp):
      "solve_ms": {"p50": f, "p95": f, "max": f},
      "batches": n, "mean_batch_size": f, "cache": {...},
      "modes": {mode: n}, "mode_transitions": n,
-     "device_loss_recoveries": n}
+     "device_loss_recoveries": n, "answered_approx": n}
 
 ``serve.mode`` — one line per brownout-ladder transition
 (docs/reliability.md "Degraded modes")::
@@ -58,6 +65,7 @@ SCHEMA = {
     "serve.request": (
         "id", "user", "item", "status", "reason", "tier",
         "queue_wait_ms", "solve_ms", "batch_id", "batch_size", "mode",
+        "approx", "err_bound",
     ),
     "serve.batch": (
         "batch_id", "size", "total_rows", "solve_ms", "status",
@@ -66,6 +74,7 @@ SCHEMA = {
         "requests", "ok", "rejected", "tiers", "hot_hit_rate",
         "queue_wait_ms", "solve_ms", "batches", "mean_batch_size",
         "cache", "modes", "mode_transitions", "device_loss_recoveries",
+        "answered_approx",
     ),
     # one line per brownout-ladder transition (serve/health.py): the
     # windowed signal values that drove the step, for post-mortems
@@ -115,6 +124,8 @@ class ServeMetrics:
         self.batch_sizes: list[int] = []
         self.mode_transitions = 0
         self.device_loss_recoveries = 0
+        self.answered_approx = 0
+        self.err_bounds: list[float] = []  # stamped bounds, ok+approx
 
     def record_request(self, resp: Response) -> None:
         self.by_status[resp.status] = self.by_status.get(resp.status, 0) + 1
@@ -141,6 +152,14 @@ class ServeMetrics:
         if resp.reason:
             REGISTRY.counter(
                 "serve.rejects_total", reason=resp.reason).inc()
+        if resp.ok and resp.approx:
+            # certified-approximate answers (the sampled rung): counted
+            # per mode so brownout salvage is visible next to the
+            # degraded-shed counter it replaces
+            self.answered_approx += 1
+            if resp.err_bound is not None:
+                self.err_bounds.append(float(resp.err_bound))
+            REGISTRY.counter("serve.approx_total", mode=mode).inc()
         if resp.ok:
             solver = resp.extra.get("solver") or "none"
             REGISTRY.histogram(
@@ -207,6 +226,7 @@ class ServeMetrics:
             "modes": dict(self.by_mode),
             "mode_transitions": self.mode_transitions,
             "device_loss_recoveries": self.device_loss_recoveries,
+            "answered_approx": self.answered_approx,
         }
         if cache_stats is not None:
             out["cache"] = dict(cache_stats)

@@ -85,6 +85,13 @@ class Response:
     # "bank_preferred" / "cache_only", serve/health.py) — every answer
     # AND every rejection says what regime produced it
     mode: str | None = None
+    # certified-approximate answers (the 'sampled' rung, docs/design.md
+    # §22): approx marks a subsampled payload and err_bound carries its
+    # concentration bound on the max per-row score error (0.0 when the
+    # sample covered every related row). Exact answers keep the
+    # defaults, so absence reads as exactness.
+    approx: bool = False
+    err_bound: float | None = None
     extra: dict = field(default_factory=dict)
 
     @property
@@ -105,6 +112,9 @@ class Response:
             "batch_id": self.batch_id,
             "batch_size": self.batch_size,
             "mode": self.mode,
+            "approx": bool(self.approx),
+            "err_bound": (None if self.err_bound is None
+                          else float(self.err_bound)),
         }
         if include_payload and self.scores is not None:
             out["scores"] = np.asarray(self.scores).tolist()

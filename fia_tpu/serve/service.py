@@ -27,6 +27,14 @@ scheduler's coalesced order and batches are consecutive ``max_batch``
 chunks of it, so the admitted results are bit-identical to
 ``engine.query_many(points[order], batch_queries=max_batch)`` —
 serving must not change answers (tests/test_serve.py pins this).
+
+Brownout (serve/health.py): in ``bank_preferred`` mode, misses the
+factor bank cannot answer serve a *certified approximate* answer from
+the engine's cache-less ``sampled`` sibling — ``approx=True`` plus a
+stamped error bound on the response (docs/design.md §22) — instead of
+shedding ``degraded``; ``cache_only`` remains the shed-everything
+floor. See :meth:`InfluenceService._dispatch_approx` for the isolation
+rules that keep the exact path byte-identical to an approx-off run.
 """
 
 from __future__ import annotations
@@ -103,6 +111,17 @@ class ServeConfig:
     factor_bank: bool = True
     # Brownout-ladder thresholds (serve/health.py); None = defaults.
     health: HealthConfig | None = None
+
+
+def _approx_extra(res, row: int) -> dict:
+    """BlockEntry.extra for one result row: the certificate provenance
+    ({'approx': True, 'err_bound': f} from a sampled-rung result, {}
+    from an exact one) — cached alongside the payload so later hot/disk
+    hits re-stamp the same bound instead of laundering the answer into
+    an exact-looking response."""
+    if not getattr(res, "approx", False) or res.err_bound is None:
+        return {}
+    return {"approx": True, "err_bound": float(res.err_bound[row])}
 
 
 def _resolve_mesh(mesh):
@@ -454,6 +473,7 @@ class InfluenceService:
             tid, "serve.request", t_arr, t_res, seq=0,
             id=resp.id, user=int(resp.user), item=int(resp.item),
             status=resp.status, reason=resp.reason, mode=resp.mode,
+            approx=bool(resp.approx), err_bound=resp.err_bound,
         )
         tr.record(tid, "serve.admit", t_arr, t_arr, seq=1, parent_seq=0)
         tr.record(tid, "serve.queue", t_arr, t_disp, seq=2, parent_seq=0)
@@ -465,13 +485,16 @@ class InfluenceService:
                   parent_seq=3, tier=resp.cache_tier)
         tr.record(tid, "serve.solver", t_disp, t_res, seq=5,
                   parent_seq=4, tier=resp.cache_tier,
-                  solver=resp.extra.get("solver"))
+                  solver=resp.extra.get("solver"),
+                  approx=bool(resp.approx), err_bound=resp.err_bound)
 
     def _resolve_group(self, eng, fp, live, responses) -> None:
         """Resolve one epoch group of live tickets against (eng, fp)."""
         now = self.clock()
         # cache tiers first; misses keep first-arrival order per key
         misses: dict[tuple, list[tuple[int, Ticket]]] = {}
+        approx_hot = (self.health.allows_approx()
+                      and eng.solver != "sampled")
         for pos, t in live:
             key = (fp, eng.solver) + t.req.key()
             entry = self.cache.get(key)
@@ -483,37 +506,62 @@ class InfluenceService:
                 self.cache.put(key, entry)
                 responses[pos] = self._respond(t, entry, TIER_DISK, now, eng)
                 continue
+            if approx_hot:
+                # a certified answer banked by an earlier brownout drain
+                # (hot tier only, under the sampled sibling's solver key
+                # — the exact key space above stays byte-untouched)
+                entry = self.cache.peek((fp, "sampled") + t.req.key())
+                if entry is not None:
+                    self.cache.stats.hits_hot += 1
+                    responses[pos] = self._respond(
+                        t, entry, TIER_HOT, now, eng.approx_sibling()
+                    )
+                    continue
             misses.setdefault(key, []).append((pos, t))
 
+        approx: dict[tuple, list] = {}
         if misses and self.health.mode != MODE_FULL:
-            misses = self._shed_degraded(eng, misses, responses)
+            misses, approx = self._shed_degraded(eng, misses, responses)
+        # exact-path batches dispatch FIRST: their batch ids (and bytes)
+        # match a run with approx serving disabled, where the approx
+        # misses below would have been shed before any dispatch
         if misses:
             self._dispatch_misses(eng, fp, misses, responses)
+        if approx:
+            self._dispatch_approx(eng, fp, approx, responses)
 
-    def _shed_degraded(self, eng, misses, responses) -> dict:
-        """Brownout: keep only the misses the active mode may serve.
+    def _shed_degraded(self, eng, misses, responses) -> tuple[dict, dict]:
+        """Brownout: route each miss where the active mode may serve it.
 
         ``bank_preferred`` keeps misses the precomputed factor bank
         answers in O(1) (a triangular solve against resident factors —
-        docs/design.md §14, unchanged bytes vs full mode); every other
-        miss — and every miss in ``cache_only`` — is rejected with the
-        ``degraded`` reason. Hits never reach here: degraded modes shed
-        only miss-path work.
+        docs/design.md §14, unchanged bytes vs full mode); misses the
+        bank cannot answer serve a certified approximate answer from
+        the engine's ``sampled`` sibling when the mode allows it
+        (``health.allows_approx()``) and are rejected ``degraded``
+        otherwise. In ``cache_only`` — or with ``approx_ok`` off —
+        every unbanked miss is shed ``degraded``: that mode is the
+        exhaustion floor. Hits never reach here: degraded modes shed
+        only miss-path work. Returns ``(bank_misses, approx_misses)``.
         """
         bank_ok = (
             self.health.allows_bank()
             and eng.solver == "precomputed"
             and eng.ensure_factor_bank() > 0
         )
+        approx_ok = self.health.allows_approx()
         keep: dict[tuple, list] = {}
+        approx: dict[tuple, list] = {}
         now = self.clock()
         for key, waiting in misses.items():
             if bank_ok and eng.bank_contains(key[2], key[3]):
                 keep[key] = waiting
-                continue
-            for pos, t in waiting:
-                responses[pos] = self._reject(t, REASON_DEGRADED, now)
-        return keep
+            elif approx_ok:
+                approx[key] = waiting
+            else:
+                for pos, t in waiting:
+                    responses[pos] = self._reject(t, REASON_DEGRADED, now)
+        return keep, approx
 
     def _overlap_eligible(self, eng) -> bool:
         """Windowed dispatch applies only where query_batch would run
@@ -738,6 +786,7 @@ class InfluenceService:
                 ihvp=np.array(res.ihvp[row]),
                 test_grad=np.array(res.test_grad[row]),
                 count=int(res.counts[row]),
+                extra=_approx_extra(res, row),
             )
             self.cache.put(key, entry)
             self._disk_put(eng, fp, key, entry)
@@ -764,6 +813,85 @@ class InfluenceService:
                     batch_id=bid, batch_size=len(batch),
                 )
 
+    def _dispatch_approx(self, eng, fp, misses, responses) -> None:
+        """Serve brownout misses from the certified ``sampled`` rung.
+
+        A guarded sequential dispatch stream over the engine's
+        cache-less :meth:`~fia_tpu.influence.engine.InfluenceEngine.
+        approx_sibling` (solver='sampled'): every answer is stamped
+        ``approx=True`` with its concentration error bound
+        (docs/design.md §22), and results bank only in the HOT tier
+        under the sibling's solver key — never under the exact
+        solver's hot/disk keys, so the exact path's bytes are
+        identical to a run with approx serving disabled. A classified
+        fault sheds exactly that batch with the taxonomy kind (the
+        rung is salvage — it gets no retry ladder of its own).
+
+        These dispatches also run AFTER the drain's exact-path batches
+        (stable batch ids on the exact path) and stay OUT of the
+        drain's health signals: the brownout controller listens to the
+        primary dispatch path only, so the salvage rung can neither
+        mask a sick backend with its successes nor deepen the brownout
+        with its failures.
+        """
+        sib = eng.approx_sibling()
+        keys = list(misses.keys())
+        points = np.asarray([[k[2], k[3]] for k in keys], np.int64)
+        counts = eng.index.counts_batch(points)
+        for batch in self.batcher.plan(counts):
+            bid = self._batch_id
+            self._batch_id += 1
+            self.dispatch_log.append((bid, np.array(points[batch])))
+            t0 = self.clock()
+            try:
+                inject.fire(sites.SERVE_DISPATCH)
+                with obs.span("serve.batch_dispatch", batch_id=bid,
+                              size=len(batch), approx=True):
+                    res = sib.query_batch(points[batch])
+            except Exception as e:
+                kind = taxonomy.classify(e)
+                if kind is None:
+                    raise
+                dt = self.clock() - t0
+                self.metrics.record_batch(
+                    bid, len(batch), int(counts[batch].sum()), dt,
+                    status=kind,
+                )
+                for j in batch:
+                    for pos, t in misses[keys[int(j)]]:
+                        responses[pos] = self._reject(
+                            t, kind, self.clock(), batch_id=bid,
+                            batch_size=len(batch),
+                        )
+                continue
+            dt = self.clock() - t0
+            self.metrics.record_batch(
+                bid, len(batch), int(counts[batch].sum()), dt
+            )
+            now = self.clock()
+            for row, j in enumerate(batch):
+                key = keys[int(j)]
+                entry = BlockEntry(
+                    scores=np.array(res.scores_of(row)),
+                    ihvp=np.array(res.ihvp[row]),
+                    test_grad=np.array(res.test_grad[row]),
+                    count=int(res.counts[row]),
+                    extra=_approx_extra(res, row),
+                )
+                self.cache.put((fp, sib.solver) + key[2:], entry)
+                for rank, (pos, t) in enumerate(misses[key]):
+                    # first waiter per key pays the compute; duplicates
+                    # coalesced into the same drain are hot-tier hits
+                    if rank == 0:
+                        tier = TIER_COMPUTE
+                    else:
+                        tier = TIER_HOT
+                        self.cache.stats.hits_hot += 1
+                    responses[pos] = self._respond(
+                        t, entry, tier, now, sib, solve_s=dt,
+                        batch_id=bid, batch_size=len(batch),
+                    )
+
     # -- response/tier helpers --------------------------------------------
     def _respond(self, t: Ticket, entry: BlockEntry, tier: str, now: float,
                  eng, solve_s: float = 0.0, batch_id=None,
@@ -778,6 +906,10 @@ class InfluenceService:
             queue_wait_s=max(now - t.t_arrival, 0.0), solve_s=solve_s,
             batch_id=batch_id, batch_size=batch_size,
             mode=self.health.mode,
+            # certificate provenance rides the cached entry, so hot/disk
+            # hits of an approximate block keep their stamped bound
+            approx=bool(entry.extra.get("approx", False)),
+            err_bound=entry.extra.get("err_bound"),
             # solver provenance for the serve.solver span + per-rung
             # histograms; extra never reaches Response.json(), so the
             # wire bytes are unchanged (and identical trace-on/off)
@@ -970,6 +1102,7 @@ class InfluenceService:
                         ihvp=np.array(res.ihvp[row]),
                         test_grad=np.array(res.test_grad[row]),
                         count=int(res.counts[row]),
+                        extra=_approx_extra(res, row),
                     )
                     self.cache.put(key, entry)
                     self._disk_put(eng, fp, key, entry)

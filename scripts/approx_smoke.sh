@@ -1,0 +1,192 @@
+#!/usr/bin/env bash
+# Certified-approximate serving smoke (docs/design.md §22,
+# docs/reliability.md "Degraded modes"): the sampled solver rung and
+# the error-bounded answers built on it, end-to-end on CPU:
+#   - certificate: a sampled-rung batch whose related-row counts exceed
+#     the sample cap must stamp every query `approx` with an
+#     `err_bound` the direct solver honors, and each (u, i) pair must
+#     serve the identical answer/bound regardless of batch composition
+#   - escalation: with a tight `sampled_tol`, over-tolerance queries
+#     must escalate one ladder rung and come back byte-identical to
+#     that rung's engine, in-tolerance queries keep their sampled
+#     answers, and the escalation is observable in the metrics registry
+#   - brownout: a forced `bank_preferred` episode must answer bank
+#     misses through the sampled rung (`approx` + honored bound, zero
+#     `degraded` sheds) while bank hits stay exact, with the rollup
+#     accounting identity intact
+#
+#   bash scripts/approx_smoke.sh        (or: make approx-smoke)
+#
+# Budget: <60s on CPU — tiny MF model, dense rating matrix so counts
+# exceed the cap, virtual clock, a throwaway tmpdir for the bank.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+DIR=$(mktemp -d /tmp/fia_approx_smoke.XXXXXX)
+trap 'rm -rf "$DIR"' EXIT
+
+JAX_PLATFORMS=cpu timeout -k 10 300 python - "$DIR" <<'EOF'
+import sys
+
+import jax
+import numpy as np
+
+from fia_tpu import obs
+from fia_tpu.data.dataset import RatingDataset
+from fia_tpu.influence import factor as fbank
+from fia_tpu.influence.engine import InfluenceEngine
+from fia_tpu.models import MF
+from fia_tpu.reliability import policy as rpolicy
+from fia_tpu.serve import (
+    HealthConfig,
+    InfluenceService,
+    Request,
+    ServeConfig,
+)
+
+WORKDIR = sys.argv[1]
+U, I, K = 30, 20, 4
+WD, DAMP = 1e-2, 1e-3
+N = 2000  # dense: related-row counts comfortably exceed the cap
+CAP = 32
+
+rng = np.random.default_rng(7)
+x = np.stack([rng.integers(0, U, N), rng.integers(0, I, N)],
+             axis=1).astype(np.int32)
+y = rng.integers(1, 6, N).astype(np.float32)
+model = MF(U, I, K, WD)
+params = model.init_params(jax.random.PRNGKey(0))
+train = RatingDataset(x, y)
+
+flat = rng.choice(U * I, size=8, replace=False)
+qp = np.asarray([(int(k // I), int(k % I)) for k in flat], np.int64)
+
+kw = dict(damping=DAMP, model_name="approx-smoke", lissa_depth=30)
+
+# ---- leg 1: the certificate ----------------------------------------
+samp = InfluenceEngine(model, params, train, solver="sampled",
+                       sampled_cap=CAP, **kw)
+res = samp.query_batch(qp)
+eb = np.asarray(res.err_bound)
+assert res.approx and eb.shape == (8,), (res.approx, res.err_bound)
+assert np.all(eb >= 0.0) and float(eb.max()) > 0.0, eb
+
+direct = InfluenceEngine(model, params, train, solver="direct", **kw)
+dref = direct.query_batch(qp)
+worst = 0.0
+for t in range(8):
+    diff = float(np.max(np.abs(np.asarray(res.scores_of(t))
+                               - np.asarray(dref.scores_of(t)))))
+    assert diff <= float(eb[t]) + 1e-6, (t, diff, eb[t])
+    worst = max(worst, diff)
+
+# batch-composition independence: the same pair served from two split
+# half-batches must reproduce the full-batch answer and bound exactly
+# (the per-(u, i) Philox sample does not see its batch neighbours)
+for lo, hi in ((0, 4), (4, 8)):
+    part = samp.query_batch(qp[lo:hi])
+    for k, t in enumerate(range(lo, hi)):
+        assert (np.asarray(part.scores_of(k)).tobytes()
+                == np.asarray(res.scores_of(t)).tobytes()), (lo, k)
+        assert float(part.err_bound[k]) == float(eb[t]), (lo, k)
+print(f"certificate leg ok: 8/8 bounds honored vs direct "
+      f"(worst diff {worst:.3g} <= max bound {float(eb.max()):.3g}), "
+      "split-batch answers bitwise-identical")
+
+# ---- leg 2: tolerance escalation -----------------------------------
+# a tolerance between the 4th and 5th smallest bound splits the batch:
+# the loose half keeps its sampled answers, the tight half escalates
+order = np.sort(eb)
+tol = float(order[3] + order[4]) / 2.0
+over = np.flatnonzero(eb > tol)
+keep = np.flatnonzero(eb <= tol)
+assert len(over) and len(keep), (tol, eb)
+
+tight = InfluenceEngine(model, params, train, solver="sampled",
+                        sampled_cap=CAP, sampled_tol=tol, **kw)
+res2 = tight.query_batch(qp)
+rung = rpolicy.next_solver("sampled")
+lref = InfluenceEngine(model, params, train, solver=rung,
+                       **kw).query_batch(qp[over])
+for k, t in enumerate(over):
+    assert (np.asarray(res2.scores_of(int(t))).tobytes()
+            == np.asarray(lref.scores_of(k)).tobytes()), int(t)
+    assert float(res2.err_bound[int(t)]) == 0.0, int(t)
+for t in keep:
+    assert (np.asarray(res2.scores_of(int(t))).tobytes()
+            == np.asarray(res.scores_of(int(t))).tobytes()), int(t)
+    assert float(res2.err_bound[int(t)]) == float(eb[int(t)]), int(t)
+
+snap = obs.REGISTRY.snapshot()["counters"]
+esc = snap.get("engine.sampled_escalations{reason=tolerance}", 0)
+assert esc >= len(over), (esc, len(over), snap)
+print(f"escalation leg ok: {len(over)}/8 over tol {tol:.3g} escalated "
+      f"to {rung!r} byte-identically, {len(keep)} kept sampled, "
+      f"registry saw {int(esc)} escalations")
+
+# ---- leg 3: brownout serves approx ---------------------------------
+eng = InfluenceEngine(model, params, train, solver="precomputed",
+                      cache_dir=WORKDIR, **kw)
+hot = fbank.select_hot_pairs(eng.index, max_entries=16,
+                             top_users=6, top_items=6)
+bank = fbank.build_bank(eng, hot)
+fp = fbank.bank_fingerprint("approx-smoke", model.block_size, DAMP,
+                            *eng._train_host)
+fbank.publish_bank(bank, fbank.default_bank_path(WORKDIR,
+                                                 "approx-smoke"), fp)
+assert eng.ensure_factor_bank() >= 6, len(bank)
+banked = [(int(u), int(i)) for u, i in hot]
+misses = [tuple(int(v) for v in p) for p in qp
+          if tuple(int(v) for v in p) not in set(banked)][:2]
+assert len(misses) == 2
+
+bank_ref = np.asarray(eng.query_batch(
+    np.asarray([banked[0]], np.int64)).scores_of(0)).copy()
+
+svc = InfluenceService(
+    engine=eng,
+    config=ServeConfig(
+        max_batch=4, max_queue=64, disk_cache=False,
+        health=HealthConfig(window=4, err_degrade=0.5,
+                            err_cache_only=2.0, err_recover=0.25,
+                            min_evidence=2, queue_hold=3, hold=8),
+    ),
+    clock=rpolicy.VirtualClock(),
+)
+# one synthetic over-threshold evidence window forces the episode —
+# deterministic, no fault plan needed (the controller only consumes
+# the observe() signal)
+svc.health.observe(errors=8, dispatches=8, queue_depth=0,
+                   queue_cap=svc.admission.max_queue)
+assert svc.health.mode == "bank_preferred", svc.health.mode
+
+reqs = [Request(*banked[0], id="b0"),
+        Request(*misses[0], id="m0"),
+        Request(*misses[1], id="m1")]
+rejected = [r for r in map(svc.submit, reqs) if r is not None]
+got = {r.id: r for r in rejected + svc.drain()}
+b0 = got["b0"]
+assert b0.ok and not b0.approx and b0.err_bound is None, b0
+assert np.array_equal(np.asarray(b0.scores), bank_ref), b0
+for rid, p in (("m0", misses[0]), ("m1", misses[1])):
+    r = got[rid]
+    assert r.ok and r.approx and r.mode == "bank_preferred", (
+        rid, r.status, r.reason, r.approx, r.mode)
+    assert r.err_bound is not None and float(r.err_bound) >= 0.0, rid
+    ref = np.asarray(direct.query_batch(
+        np.asarray([p], np.int64)).scores_of(0))
+    diff = float(np.max(np.abs(np.asarray(r.scores) - ref)))
+    assert diff <= float(r.err_bound) + 1e-6, (rid, diff, r.err_bound)
+
+roll = svc.rollup()
+assert roll["rejected"].get("degraded") is None, roll["rejected"]
+assert roll["answered_approx"] == 2, roll
+# accounting identity: every admitted request is answered exactly,
+# answered approximately, or rejected with a reason — nothing vanishes
+assert roll["requests"] == roll["ok"] + sum(roll["rejected"].values()), roll
+assert roll["ok"] == 3 and roll["answered_approx"] == 2, roll
+print("brownout leg ok: bank hit exact, 2 misses answered approx with "
+      "honored bounds, zero degraded sheds, accounting identity holds")
+EOF
+
+echo "approx-smoke PASS"

@@ -7,8 +7,10 @@
 #     single-device reference — zero sheds, zero unclassified errors
 #   - brownout: forced dispatch failures must step the health ladder
 #     down to `bank_preferred`, where factor-bank hits keep serving
-#     byte-identical answers, misses shed with reason `degraded`, and
-#     calm traffic steps the mode back to `full` with no flapping
+#     byte-identical answers, misses are answered through the certified
+#     sampled rung (stamped `approx` with an honored `err_bound`,
+#     docs/design.md §22) instead of shedding, and calm traffic steps
+#     the mode back to `full` with no flapping
 #
 #   bash scripts/degraded_smoke.sh        (or: make degraded-smoke)
 #
@@ -162,14 +164,24 @@ with inject.active(
 assert all(not r.ok and r.reason == taxonomy.WORKER for r in shed), shed
 assert svc.health.mode == "bank_preferred", svc.health.mode
 
-# degraded serving: the banked pair answers byte-identically, the miss
-# sheds with the canonical `degraded` reason, both stamped with the mode
+# degraded serving: the banked pair answers byte-identically (exact,
+# no approx stamp); the miss is answered through the certified sampled
+# rung — stamped approx with an err_bound the direct solver honors —
+# instead of shedding (docs/design.md §22)
 got = {r.id: r for r in wave(svc, [Request(*banked[0], id="b0"),
                                    Request(*misses[2], id="m2")])}
 b0, m2 = got["b0"], got["m2"]
 assert b0.ok and np.array_equal(np.asarray(b0.scores),
                                 bank_ref[banked[0]]), b0
-assert not m2.ok and m2.reason == "degraded", (m2.status, m2.reason)
+assert not b0.approx and b0.err_bound is None, (b0.approx, b0.err_bound)
+assert m2.ok and m2.approx, (m2.status, m2.reason, m2.approx)
+assert m2.err_bound is not None and float(m2.err_bound) >= 0.0, m2
+direct = InfluenceEngine(model, params, train, damping=DAMP,
+                         solver="direct", model_name="degraded-smoke")
+ref_scores = np.asarray(direct.query_batch(
+    np.asarray([misses[2]], np.int64)).scores_of(0))
+diff = float(np.max(np.abs(np.asarray(m2.scores) - ref_scores)))
+assert diff <= float(m2.err_bound) + 1e-6, (diff, m2.err_bound)
 assert b0.mode == m2.mode == "bank_preferred", (b0.mode, m2.mode)
 
 # calm: fresh bank hits are clean dispatches; the ladder must step
@@ -186,11 +198,13 @@ assert trs == [("full", "bank_preferred"),
                ("bank_preferred", "full")], trs
 
 roll = svc.rollup()
-assert roll["rejected"].get("degraded") == 1, roll["rejected"]
+assert roll["rejected"].get("degraded") is None, roll["rejected"]
+assert roll["answered_approx"] == 1, roll
 assert roll["mode_transitions"] == 2, roll
 assert roll["modes"].get("bank_preferred", 0) >= 2, roll["modes"]
 print(f"brownout leg ok: ladder {trs[0][0]} -> {trs[0][1]} -> "
-      f"{trs[1][1]}, bank hits byte-identical, 1 miss shed degraded")
+      f"{trs[1][1]}, bank hits byte-identical, 1 miss answered approx "
+      f"(err_bound {float(m2.err_bound):.3g} honored, diff {diff:.3g})")
 EOF
 
 echo "degraded-smoke PASS"

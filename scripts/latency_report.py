@@ -22,12 +22,17 @@ import numpy as np
 # instead of rendering an empty column here. Keep it a literal dict.
 CONSUMES = {
     "serve.request": ("status", "reason", "tier", "mode",
-                      "queue_wait_ms", "solve_ms"),
+                      "queue_wait_ms", "solve_ms",
+                      "approx", "err_bound"),
     "serve.batch": ("size", "solve_ms"),
     "serve.rollup": ("cache",),
     # the final registry snapshot (fia_tpu/obs): per-solver-rung and
     # per-serving-mode µs histograms rendered as p50/p99 below
     "obs.metrics": ("snapshot",),
+    # span stream (fia_tpu/obs/events.py): scanned for the
+    # ``engine.sampled`` markers the certified sampled rung attaches
+    # to its dispatch spans (queries / escalations / max bound)
+    "obs.span": ("name", "events"),
 }
 
 # The canonical rejection reasons (fia_tpu/serve/admission.py). The
@@ -46,7 +51,7 @@ def pcts(vals):
 
 
 def load(path: str):
-    reqs, batches, rollups = [], [], []
+    reqs, batches, rollups, sampled = [], [], [], []
     snapshot = None
     with open(path) as fh:
         for line in fh:
@@ -66,7 +71,12 @@ def load(path: str):
                 rollups.append(d)
             elif ev == "obs.metrics":
                 snapshot = d.get("snapshot")  # last one wins
-    return reqs, batches, rollups, snapshot
+            elif ev == "obs.span":
+                # the sampled rung stamps one marker per dispatch on
+                # its enclosing span (engine._query_sampled)
+                sampled.extend(e for e in (d.get("events") or [])
+                               if e.get("name") == "engine.sampled")
+    return reqs, batches, rollups, snapshot, sampled
 
 
 def hist_pct(h: dict, buckets: list, q: float) -> float:
@@ -112,7 +122,7 @@ def main(argv) -> int:
     if len(argv) != 2:
         print(__doc__.strip(), file=sys.stderr)
         return 2
-    reqs, batches, rollups, snapshot = load(argv[1])
+    reqs, batches, rollups, snapshot, sampled = load(argv[1])
     if not reqs and not rollups:
         print(f"no serving events in {argv[1]}", file=sys.stderr)
         return 1
@@ -149,6 +159,25 @@ def main(argv) -> int:
         if t in by_tier:
             print(f"  tier[{t}]: {by_tier[t]} "
                   f"({100.0 * by_tier[t] / served:.1f}%)")
+
+    # certified-approximate answer class (docs/design.md §22): answers
+    # served from the sampled rung, each carrying a stamped error bound
+    approx = [r for r in ok if r.get("approx")]
+    if approx:
+        bounds = [float(r["err_bound"]) for r in approx
+                  if r.get("err_bound") is not None]
+        mean_eb = f"{np.mean(bounds):.4g}" if bounds else "n/a"
+        print(f"approx: {len(approx)} "
+              f"({100.0 * len(approx) / len(ok):.1f}% of ok)  "
+              f"mean err_bound {mean_eb}")
+        print(f"  approx solve: {pcts([r['solve_ms'] for r in approx])}")
+    if sampled:
+        q = sum(int(e.get("queries", 0)) for e in sampled)
+        esc = sum(int(e.get("escalated", 0)) for e in sampled)
+        err_max = max((float(e.get("err_max", 0.0)) for e in sampled),
+                      default=0.0)
+        print(f"sampled rung: dispatches={len(sampled)}  queries={q}  "
+              f"escalated={esc}  err_bound_max={err_max:.4g}")
 
     print(f"queue wait: {pcts([r['queue_wait_ms'] for r in ok])}")
     print(f"solve:      {pcts([r['solve_ms'] for r in ok])}")

@@ -53,6 +53,14 @@ bash scripts/degraded_smoke.sh || {
   echo "degraded-smoke FAILED (run make degraded-smoke)"
   exit 1
 }
+# Approx smoke, FATAL: the certified sampled rung — error bounds
+# honored vs the direct solver, tolerance escalation byte-identical to
+# the next rung, brownout misses answered approx instead of shed
+# (docs/design.md §22).
+bash scripts/approx_smoke.sh || {
+  echo "approx-smoke FAILED (run make approx-smoke)"
+  exit 1
+}
 # Kernel smoke, FATAL: fused score-kernel parity — Pallas (interpret)
 # allclose + rank-exact and the XLA analytic twin BITWISE vs the
 # vmapped-autodiff reference, both geometries, plus an XLA-twin serve

@@ -8,9 +8,11 @@ and the brownout ladder.
   replaying the signals reproduces the transition log exactly, and the
   hysteresis rules (sustained evidence down, held calm up, dead band)
   make flapping structurally impossible;
-- degraded modes shed only miss-path work, stamped with the canonical
-  ``degraded`` reason; cache and bank hits keep serving unchanged
-  bytes.
+- ``bank_preferred`` answers miss-path work through the certified
+  ``sampled`` rung (stamped ``approx`` with an honored ``err_bound``,
+  docs/design.md §22) unless ``approx_ok=False``; ``cache_only`` still
+  sheds every miss with the canonical ``degraded`` reason; cache and
+  bank hits keep serving unchanged bytes.
 """
 
 import jax
@@ -425,7 +427,12 @@ class TestBrownoutServing:
         kw.setdefault("err_cache_only", 2.0)
         return HealthConfig(**kw)
 
-    def test_bank_preferred_serves_bank_shed_misses(self, tmp_path):
+    def test_bank_preferred_serves_bank_answers_misses_approx(
+            self, tmp_path):
+        """The certified-approx brownout contract: a bank_preferred
+        miss is ANSWERED from the sampled rung (approx=True with a
+        stamped error bound, within that bound of the exact answer),
+        not shed ``degraded`` — docs/design.md §22."""
         model, params, train = _setup()
         eng, banked = self._bank_engine(model, params, train, tmp_path)
         misses = [tuple(p) for p in _unique_points(train, 20)
@@ -443,12 +450,43 @@ class TestBrownoutServing:
         got = {r.id: r for r in svc.drain()}
         b0, m2 = got["b0"], got["m2"]
         assert b0.ok and np.array_equal(np.asarray(b0.scores), ref)
-        assert not m2.ok and m2.reason == REASON_DEGRADED
+        assert not b0.approx and b0.err_bound is None
+        assert m2.ok and m2.approx and m2.err_bound is not None
         assert b0.mode == m2.mode == MODE_BANK_PREFERRED
 
+        # the stamped certificate is honored against the exact solver
+        exact = InfluenceEngine(
+            model, params, train, damping=DAMP, solver="direct",
+            model_name="degraded-test")
+        ref_m = np.asarray(exact.query_batch(
+            np.asarray([misses[2]], np.int64)).scores_of(0))
+        diff = float(np.max(np.abs(np.asarray(m2.scores) - ref_m)))
+        assert diff <= float(m2.err_bound) + 1e-6
+
+        roll = svc.rollup()
+        assert roll["rejected"].get(REASON_DEGRADED) is None
+        assert roll["answered_approx"] == 1
+        assert roll["modes"].get(MODE_BANK_PREFERRED, 0) >= 2
+
+    def test_bank_preferred_approx_off_sheds_degraded(self, tmp_path):
+        """``approx_ok=False`` restores the shed-everything brownout:
+        the same episode rejects the miss ``degraded``."""
+        model, params, train = _setup()
+        eng, banked = self._bank_engine(model, params, train, tmp_path)
+        misses = [tuple(p) for p in _unique_points(train, 20)
+                  if tuple(p) not in set(banked)][:3]
+        svc = _service(eng, max_batch=4, max_queue=64,
+                       health=self._health_cfg(approx_ok=False))
+        self._degrade(svc, misses[:2])
+        assert svc.health.mode == MODE_BANK_PREFERRED
+
+        svc.submit(Request(*misses[2], id="m2"))
+        (m2,) = svc.drain()
+        assert not m2.ok and m2.reason == REASON_DEGRADED
+        assert not m2.approx and m2.err_bound is None
         roll = svc.rollup()
         assert roll["rejected"].get(REASON_DEGRADED) == 1
-        assert roll["modes"].get(MODE_BANK_PREFERRED, 0) >= 2
+        assert roll["answered_approx"] == 0
 
     def test_recovers_to_full_without_flapping(self, tmp_path):
         model, params, train = _setup()

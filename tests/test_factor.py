@@ -138,8 +138,9 @@ class TestFactorBankServing:
         st = eng.bank_stats()
         assert st["misses"] == len(miss) and st["hits"] == 0
 
-        # the miss rung is the ladder's next engine verbatim
-        ladder = _engine(model, params, train, solver="lissa")
+        # the miss rung is the ladder's next engine verbatim — since
+        # the certified rung landed that is ``sampled``, not lissa
+        ladder = _engine(model, params, train, solver="sampled")
         res_ref = ladder.query_batch(miss)
         for t in range(len(miss)):
             assert np.array_equal(res.scores_of(t), res_ref.scores_of(t))
@@ -172,7 +173,7 @@ class TestFactorBankServing:
         bank_eng.ensure_factor_bank()
         res_hit = bank_eng.query_batch(mixed[hit_pos])
         assert bank_eng.bank_stats()["hits"] == len(hit_pos)
-        ladder = _engine(model, params, train, solver="lissa")
+        ladder = _engine(model, params, train, solver="sampled")
         res_miss = ladder.query_batch(mixed[miss_pos])
         for k, t in enumerate(hit_pos):
             assert np.array_equal(res.scores_of(t), res_hit.scores_of(k))
@@ -181,7 +182,7 @@ class TestFactorBankServing:
 
     def test_fallback_chain_precomputed_to_direct(self, tmp_path):
         """Injected NaN payloads at every rung walk the full ladder
-        precomputed -> lissa -> cg -> direct, ending finite."""
+        precomputed -> sampled -> lissa -> cg -> direct, ending finite."""
         model, train = _setup()
         params = model.init_params(jax.random.PRNGKey(0))
         _, bank, _ = _publish(tmp_path, model, params, train)
@@ -198,10 +199,12 @@ class TestFactorBankServing:
             return nxt
 
         # one NaN corruption per rung above the bottom; pad_to pins a
-        # single pad group so each recompute is exactly one corrupt call
+        # single pad group so each recompute is exactly one corrupt call.
+        # Every rung (sampled included) shares the ENGINE_SOLVE payload
+        # seam — the fetched iHVP host buffer.
         faults = [
             inject.Fault(site=sites.ENGINE_SOLVE, at=k, kind="nan")
-            for k in range(3)
+            for k in range(4)
         ]
         with inject.active(*faults):
             try:
@@ -211,7 +214,9 @@ class TestFactorBankServing:
                 rpolicy.next_solver = real_next
 
         assert eng.solver == "direct"
-        assert [w[0] for w in walked] == ["precomputed", "lissa", "cg"]
+        assert [w[0] for w in walked] == [
+            "precomputed", "sampled", "lissa", "cg"
+        ]
         assert np.isfinite(res.ihvp).all()
         ref = _engine(model, params, train, solver="direct")
         res_ref = ref.query_batch(pts, pad_to=128)
@@ -232,7 +237,7 @@ class TestFactorBankServing:
 
         pts = np.asarray(bank.pairs[:3], np.int64)
         res = eng.query_batch(pts)
-        ladder = _engine(model, params, train, solver="lissa")
+        ladder = _engine(model, params, train, solver="sampled")
         res_ref = ladder.query_batch(pts)
         for t in range(len(pts)):
             assert np.array_equal(res.scores_of(t), res_ref.scores_of(t))
@@ -313,6 +318,6 @@ class TestSurgicalInvalidation:
         pts = np.asarray([bank.pairs[0]], np.int64)
         res = eng.query_batch(pts)
         assert eng.bank_stats()["misses"] == 1
-        ladder = _engine(model, new_params, train, solver="lissa")
+        ladder = _engine(model, new_params, train, solver="sampled")
         assert np.array_equal(res.scores_of(0),
                               ladder.query_batch(pts).scores_of(0))

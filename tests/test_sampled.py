@@ -1,0 +1,206 @@
+"""The certified ``sampled`` solver rung (docs/design.md §22).
+
+- the estimator is Horvitz–Thompson: with the cap at or above every
+  related-row count nothing is left out, and the program is BITWISE
+  identical to the exact solve with ``err_bound == 0``;
+- the certificate is honored: |sampled − direct| per query stays
+  within the stamped bound;
+- sampling is keyed on the (u, i) pair, not the batch — the same pair
+  serves the same bytes and bound from any batch composition;
+- over-tolerance queries escalate one ladder rung per query and come
+  back byte-identical to that rung's engine, in-tolerance neighbours
+  keep their sampled answers;
+- a classified fault during a sampled dispatch degrades the whole
+  batch to the fallback rung;
+- ``approx_sibling()`` is the serving layer's handle on the rung: a
+  config-identical sampled engine with no disk cache.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from fia_tpu.data.dataset import RatingDataset
+from fia_tpu.influence import sampled as sampled_mod
+from fia_tpu.influence.engine import InfluenceEngine
+from fia_tpu.models import MF
+from fia_tpu.reliability import inject, sites, taxonomy
+from fia_tpu.reliability import policy as rpolicy
+
+U, I, K = 12, 10, 3
+WD = 1e-2
+DAMP = 1e-3
+CAP = 8  # far below the ~100 related rows per pair at n=600
+
+
+def _setup(seed=0, n=600):
+    rng = np.random.default_rng(seed)
+    x = np.stack(
+        [rng.integers(0, U, n), rng.integers(0, I, n)], axis=1
+    ).astype(np.int32)
+    y = rng.integers(1, 6, n).astype(np.float32)
+    train = RatingDataset(x, y)
+    model = MF(U, I, K, WD)
+    params = model.init_params(jax.random.PRNGKey(seed))
+    return model, params, train
+
+
+def _engine(model, params, train, **kw):
+    kw.setdefault("damping", DAMP)
+    kw.setdefault("lissa_depth", 30)
+    return InfluenceEngine(model, params, train, **kw)
+
+
+def _points(train, n):
+    uniq = np.unique(train.x, axis=0)
+    assert len(uniq) >= n
+    return uniq[:n].astype(np.int64)
+
+
+@pytest.fixture(scope="module")
+def workload():
+    model, params, train = _setup()
+    return model, params, train, _points(train, 6)
+
+
+class TestEstimator:
+    def test_exact_at_cap_bitwise(self, workload):
+        model, params, train, pts = workload
+        samp = _engine(model, params, train, solver="sampled",
+                       sampled_cap=10**6)
+        ref = _engine(model, params, train, solver="direct")
+        res, res_ref = samp.query_batch(pts), ref.query_batch(pts)
+        assert res.approx and res.err_bound is not None
+        assert np.all(np.asarray(res.err_bound) == 0.0)
+        for t in range(len(pts)):
+            assert (np.asarray(res.scores_of(t)).tobytes()
+                    == np.asarray(res_ref.scores_of(t)).tobytes()), t
+
+    def test_certificate_honored_vs_direct(self, workload):
+        model, params, train, pts = workload
+        samp = _engine(model, params, train, solver="sampled",
+                       sampled_cap=CAP)
+        ref = _engine(model, params, train, solver="direct")
+        res, res_ref = samp.query_batch(pts), ref.query_batch(pts)
+        eb = np.asarray(res.err_bound)
+        assert np.all(eb >= 0.0) and float(eb.max()) > 0.0
+        for t in range(len(pts)):
+            diff = float(np.max(np.abs(
+                np.asarray(res.scores_of(t))
+                - np.asarray(res_ref.scores_of(t)))))
+            assert diff <= float(eb[t]) + 1e-6, (t, diff, eb[t])
+
+    def test_per_pair_determinism_across_batches(self, workload):
+        model, params, train, pts = workload
+        samp = _engine(model, params, train, solver="sampled",
+                       sampled_cap=CAP)
+        res = samp.query_batch(pts)
+        for t in range(len(pts)):
+            solo = samp.query_batch(pts[t:t + 1])
+            assert (np.asarray(solo.scores_of(0)).tobytes()
+                    == np.asarray(res.scores_of(t)).tobytes()), t
+            assert (float(solo.err_bound[0])
+                    == float(res.err_bound[t])), t
+
+
+class TestSampleWeights:
+    def test_exhaustive_below_cap(self):
+        pairs = np.asarray([[1, 2], [3, 4]], np.int64)
+        counts = np.asarray([3, 5])
+        ws, m = sampled_mod.sample_weights(pairs, counts, 12, cap=8)
+        assert m.tolist() == [3, 5]
+        assert np.all(ws[:8] == 1.0) and np.all(ws[8:] == 0.0)
+
+    def test_horvitz_thompson_weights(self):
+        pairs = np.asarray([[1, 2]], np.int64)
+        counts = np.asarray([40])
+        ws, m = sampled_mod.sample_weights(pairs, counts, 48, cap=10)
+        assert m.tolist() == [10]
+        picked = np.flatnonzero(ws)
+        assert len(picked) == 10 and np.all(picked < 40)
+        # each sampled row carries n/m so the accumulation is unbiased
+        assert np.allclose(ws[picked], 4.0)
+        assert float(ws.sum()) == pytest.approx(40.0)
+
+    def test_sample_keyed_on_pair_not_position(self):
+        pairs2 = np.asarray([[9, 9], [1, 2]], np.int64)
+        counts2 = np.asarray([40, 40])
+        ws2, _ = sampled_mod.sample_weights(pairs2, counts2, 80, cap=10)
+        ws1, _ = sampled_mod.sample_weights(
+            pairs2[1:], counts2[1:], 40, cap=10)
+        assert np.array_equal(np.flatnonzero(ws2[40:]),
+                              np.flatnonzero(ws1))
+
+
+class TestEscalation:
+    def test_tolerance_splits_the_batch(self, workload):
+        model, params, train, pts = workload
+        base = _engine(model, params, train, solver="sampled",
+                       sampled_cap=CAP)
+        res = base.query_batch(pts)
+        eb = np.asarray(res.err_bound)
+        order = np.sort(eb)
+        tol = float(order[len(pts) // 2 - 1]
+                    + order[len(pts) // 2]) / 2.0
+        over = np.flatnonzero(eb > tol)
+        keep = np.flatnonzero(eb <= tol)
+        assert len(over) and len(keep), eb
+
+        tight = _engine(model, params, train, solver="sampled",
+                        sampled_cap=CAP, sampled_tol=tol)
+        res2 = tight.query_batch(pts)
+        rung = rpolicy.next_solver("sampled")
+        ref = _engine(model, params, train,
+                      solver=rung).query_batch(pts[over])
+        for k, t in enumerate(over):
+            assert (np.asarray(res2.scores_of(int(t))).tobytes()
+                    == np.asarray(ref.scores_of(k)).tobytes()), int(t)
+            assert float(res2.err_bound[int(t)]) == 0.0
+        for t in keep:
+            assert (np.asarray(res2.scores_of(int(t))).tobytes()
+                    == np.asarray(res.scores_of(int(t))).tobytes())
+            assert float(res2.err_bound[int(t)]) == float(eb[int(t)])
+        assert res2.approx
+
+    def test_classified_fault_degrades_whole_batch(self, workload):
+        model, params, train, pts = workload
+        samp = _engine(model, params, train, solver="sampled",
+                       sampled_cap=CAP)
+        rung = rpolicy.next_solver("sampled")
+        ref = _engine(model, params, train,
+                      solver=rung).query_batch(pts)
+        with inject.active(
+            inject.Fault(site=sites.ENGINE_SAMPLED_SOLVE, at=0,
+                         kind=taxonomy.WORKER),
+            strict=True, validate=True,
+        ):
+            res = samp.query_batch(pts)
+        for t in range(len(pts)):
+            assert (np.asarray(res.scores_of(t)).tobytes()
+                    == np.asarray(ref.scores_of(t)).tobytes()), t
+
+
+class TestApproxSibling:
+    def test_sampled_engine_is_its_own_sibling(self, workload):
+        model, params, train, _ = workload
+        samp = _engine(model, params, train, solver="sampled")
+        assert samp.approx_sibling() is samp
+
+    def test_sibling_is_sampled_no_disk(self, workload, tmp_path):
+        model, params, train, pts = workload
+        eng = _engine(model, params, train, solver="precomputed",
+                      cache_dir=str(tmp_path), sampled_cap=CAP)
+        sib = eng.approx_sibling()
+        assert sib.solver == "sampled" and sib.cache_dir is None
+        assert sib.sampled_cap == CAP
+        assert sib is eng.approx_sibling()  # cached, built once
+        # the sibling serves the rung's exact bytes and certificate
+        direct = _engine(model, params, train, solver="sampled",
+                         sampled_cap=CAP).query_batch(pts[:2])
+        got = sib.query_batch(pts[:2])
+        for t in range(2):
+            assert (np.asarray(got.scores_of(t)).tobytes()
+                    == np.asarray(direct.scores_of(t)).tobytes())
+        assert np.array_equal(np.asarray(got.err_bound),
+                              np.asarray(direct.err_bound))
