@@ -1,7 +1,7 @@
 # Convenience targets. The commands themselves are pinned in
 # ROADMAP.md (tier-1) and scripts/ — these targets just name them.
 
-.PHONY: tier1 test lint lint-io serve-smoke multichip-smoke factor-smoke chaos-smoke chaos-soak churn-smoke degraded-smoke approx-smoke kernel-smoke scale-smoke obs-smoke
+.PHONY: tier1 test lint lint-io serve-smoke serve-soak multichip-smoke factor-smoke chaos-smoke chaos-soak churn-smoke degraded-smoke approx-smoke kernel-smoke scale-smoke obs-smoke
 
 # The ROADMAP.md tier-1 verify: fast CPU suite, slow tests excluded.
 # Lint is fatal — a finding fails the build before pytest runs.
@@ -94,6 +94,13 @@ approx-smoke:
 # tier, per-device table residency shrinking with model_parallel.
 scale-smoke:
 	bash scripts/scale_smoke.sh
+
+# Serve soak: the multi-tenant endurance run — a long seeded traffic
+# replay (diurnal curve, tenant mix, 2× scavenger overload episode)
+# plus one forced brownout episode, with the starvation oracle
+# asserted at the end (docs/design.md §12); not part of tier-1.
+serve-soak:
+	JAX_PLATFORMS=cpu python bench.py serve --soak --quick
 
 # Chaos soak: a seed-range sweep over the FULL fault domain (kill
 # kinds, NaN payloads, deadlines) — the fuzz mode; not part of tier-1.

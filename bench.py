@@ -477,6 +477,129 @@ def _serve_brownout(model, params, train, pool, damping) -> dict:
     }
 
 
+def _serve_multitenant(model, params, train, pool, damping,
+                       hours: int = 24, base: int = 12,
+                       seed: int = 41) -> dict:
+    """Seeded multi-tenant traffic replay (docs/design.md §12): a
+    diurnal sinusoid load curve over ``hours`` virtual hours with
+    hot-key skew and a fixed tenant mix (interactive 0.2 / batch 0.5 /
+    scavenger 0.3), plus a 2× scavenger overload episode pinned to the
+    peak hours — the per-class quota must shed the excess as
+    class-tagged ``overload`` while interactive latency holds. The
+    whole replay runs on a deterministic tick clock, so the same seed
+    reproduces the same per-class latency stamps bit-for-bit.
+
+    Per-class p50/p99 queue waits are read back from the
+    class-labelled obs histograms (``serve.queue_wait_by_class_us``)
+    rather than recomputed host-side — the replay doubles as an
+    end-to-end check that the fairness dashboards see real data.
+    Fairness is Jain's index over per-class service rates (ok/offered);
+    1.0 = every class served at the same rate, lower = the overload
+    episode concentrated its sheds."""
+    import math
+
+    from fia_tpu.influence.engine import InfluenceEngine
+    from fia_tpu.obs.registry import REGISTRY, percentile_from_snapshot
+    from fia_tpu.serve import InfluenceService, Request, ServeConfig
+
+    class _TickClock:
+        def __init__(self):
+            self.t = 0.0
+
+        def __call__(self):
+            self.t += 1e-3
+            return self.t
+
+    mix = (("interactive", 0.2), ("batch", 0.5), ("scavenger", 0.3))
+    classes = [c for c, _ in mix]
+    probs = [p for _, p in mix]
+    rng = np.random.default_rng(seed)
+    hot = pool[rng.choice(len(pool), size=max(len(pool) // 8, 4),
+                          replace=False)]
+    eng = InfluenceEngine(model, params, train, damping=damping,
+                          solver="direct")
+    clock = _TickClock()
+    svc = InfluenceService(engine=eng, clock=clock, config=ServeConfig(
+        max_batch=16, max_queue=64, disk_cache=False))
+    REGISTRY.reset()  # the class histograms below cover THIS replay
+
+    # the 2× overload episode rides the top of the sinusoid
+    peak = hours // 4
+    episode_hours = {peak, peak + 1}
+    scav_cap = svc.admission.class_caps["scavenger"]
+    offered = {c: 0 for c in classes}
+    responses = []
+    flood_total = 0
+    for h in range(hours):
+        load = base * (1.0 + 0.8 * math.sin(2 * math.pi * h / hours))
+        wave = []
+        for j in range(max(1, int(round(load)))):
+            cls = classes[int(rng.choice(len(classes), p=probs))]
+            src = hot if rng.random() < 0.5 else pool
+            u, i = src[rng.integers(len(src))]
+            wave.append(Request(user=int(u), item=int(i),
+                                id=f"h{h}r{j}", cls=cls,
+                                tenant=f"t-{cls}"))
+        if h in episode_hours:
+            flood = [Request(user=int(u), item=int(i),
+                             id=f"h{h}f{j}", cls="scavenger",
+                             tenant="t-scavflood")
+                     for j, (u, i) in enumerate(
+                         pool[rng.integers(len(pool),
+                                           size=2 * scav_cap)])]
+            wave += flood
+            flood_total += len(flood)
+        for req in wave:
+            offered[req.cls] += 1
+            r = svc.submit(req)
+            if r is not None:
+                responses.append(r)
+        responses.extend(svc.drain())
+    roll = svc.rollup()
+
+    # starvation oracle: every admitted request resolved in-replay,
+    # and the class lanes partition the stream exactly
+    unresolved = sum(offered.values()) - len(responses)
+    assert unresolved == 0, \
+        f"multi-tenant replay starved {unresolved} request(s)"
+    for cls, lane in roll["classes"].items():
+        assert lane["ok"] + sum(lane["rejected"].values()) \
+            == lane["requests"], f"class {cls!r} accounting leak: {lane}"
+    max_wait_s = max((r.queue_wait_s for r in responses
+                      if r.reason not in ("overload", "invalid")),
+                     default=0.0)
+
+    # per-class latency from the labelled registry histograms — the
+    # same series the dashboards read (µs in the registry)
+    snap = REGISTRY.snapshot()
+    per_class = {}
+    for cls in classes:
+        h = snap["histograms"].get(
+            f"serve.queue_wait_by_class_us{{class={cls}}}")
+        lane = roll["classes"].get(cls, {})
+        per_class[cls] = {
+            "offered": offered[cls],
+            "ok": lane.get("ok", 0),
+            "rejected": lane.get("rejected", {}),
+            "queue_wait_p50_ms": round(
+                percentile_from_snapshot(h, 50) / 1e3, 3) if h else 0.0,
+            "queue_wait_p99_ms": round(
+                percentile_from_snapshot(h, 99) / 1e3, 3) if h else 0.0,
+        }
+    rates = [per_class[c]["ok"] / max(offered[c], 1) for c in classes]
+    jain = (sum(rates) ** 2 / (len(rates) * sum(r * r for r in rates))
+            if any(rates) else 0.0)
+    return {
+        "hours": hours,
+        "requests": sum(offered.values()),
+        "flood_requests": flood_total,
+        "per_class": per_class,
+        "fairness_jain": round(jain, 4),
+        "max_admitted_wait_ms": round(max_wait_s * 1e3, 3),
+        "scavenger_quota_cap": scav_cap,
+    }
+
+
 def _maybe_json_out(out: dict) -> None:
     """``--json_out PATH``: atomic file copy of the JSON line
     (orchestration scripts merge stdout into their watch logs); stdout
@@ -1261,6 +1384,17 @@ def serve_main():
            f"approx answers, "
            f"{brownout_approx['degraded_rejections']} degraded")
 
+    # seeded multi-tenant traffic replay: diurnal curve, tenant mix,
+    # 2× scavenger overload episode, per-class latency + fairness
+    _stage("multi-tenant replay (diurnal curve, 2x scavenger episode)")
+    multitenant = _serve_multitenant(model, state.params, train, pool,
+                                     damping,
+                                     hours=12 if QUICK else 24)
+    _stage(f"multi-tenant: {multitenant['requests']} requests, "
+           f"fairness {multitenant['fairness_jain']}, interactive p99 "
+           f"{multitenant['per_class']['interactive']['queue_wait_p99_ms']}"
+           f"ms")
+
     unreasoned = sum(1 for r in responses if not r.ok and not r.reason)
     from fia_tpu.serve import (
         REASON_DEADLINE,
@@ -1315,9 +1449,76 @@ def serve_main():
             "wall_s": round(wall, 2),
             "multi_device": multi_device,
             "brownout_approx": brownout_approx,
+            "multitenant": multitenant,
         },
     }
     assert unreasoned == 0, "serving dropped requests without a reason"
+    print(json.dumps(out))
+    _maybe_json_out(out)
+
+
+def serve_soak_main():
+    """``python bench.py serve --soak [--quick]`` — the multi-tenant
+    endurance run (``make serve-soak``, NOT tier-1).
+
+    A longer seeded traffic replay than the ``serve`` stage (more
+    virtual hours of the same diurnal curve, tenant mix and 2×
+    scavenger overload episode) followed by one forced brownout
+    episode, with the starvation oracle asserted at the end: every
+    admitted request resolved, and no admitted request waited past a
+    pinned bound — under overload the fair scheduler may *shed*
+    scavenger work, but it must never park it forever.
+    """
+    _ensure_live_backend()
+    import jax
+
+    from fia_tpu.data.synthetic import sample_heldout_pairs, synthesize_ratings
+    from fia_tpu.models import MF
+    from fia_tpu.train.trainer import Trainer, TrainConfig
+
+    if QUICK:
+        users, items, rows, steps, hours = 300, 200, 20_000, 1_000, 48
+    else:
+        users, items, rows, steps, hours = 600, 400, 50_000, 3_000, 96
+    k, wd, damping, batch = 16, 1e-3, 1e-6, 2000
+
+    _stage(f"serve soak: training {steps} steps on {rows} rows")
+    train = synthesize_ratings(users, items, rows, seed=0)
+    model = MF(users, items, k, wd)
+    tr = Trainer(model, TrainConfig(batch_size=batch, num_steps=steps,
+                                    learning_rate=1e-2))
+    state = tr.fit(tr.init_state(model.init_params(jax.random.PRNGKey(0))),
+                   train.x, train.y)
+    pool = sample_heldout_pairs(train.x, users, items, 256, seed=17)
+
+    _stage(f"multi-tenant replay: {hours} virtual hours")
+    replay = _serve_multitenant(model, state.params, train, pool,
+                                damping, hours=hours, base=16, seed=43)
+
+    _stage("brownout episode (forced bank_preferred)")
+    brownout = _serve_brownout(model, state.params, train, pool, damping)
+
+    # starvation oracle: the replay already asserts every admitted
+    # request resolved; pin the wait bound too. The tick clock
+    # advances 1ms per read, so the bound is a budget on scheduler
+    # passes a request may sit through, not wall time.
+    starvation_bound_ms = 2_000.0
+    assert replay["max_admitted_wait_ms"] <= starvation_bound_ms, (
+        f"soak starvation: max admitted wait "
+        f"{replay['max_admitted_wait_ms']}ms exceeds the "
+        f"{starvation_bound_ms}ms bound")
+    out = {
+        "metric": "fia-serve multi-tenant soak (fairness index)",
+        "value": replay["fairness_jain"],
+        "unit": "jain index (per-class service rate)",
+        "details": {
+            "backend": jax.default_backend(),
+            "replay": replay,
+            "brownout": brownout,
+            "starvation_bound_ms": starvation_bound_ms,
+            "max_admitted_wait_ms": replay["max_admitted_wait_ms"],
+        },
+    }
     print(json.dumps(out))
     _maybe_json_out(out)
 
@@ -1803,6 +2004,8 @@ if __name__ == "__main__":
     if "serve" in sys.argv[1:]:
         if "--churn" in sys.argv[1:]:
             serve_churn_main()
+        elif "--soak" in sys.argv[1:]:
+            serve_soak_main()
         else:
             serve_main()
     elif "multichip" in sys.argv[1:]:

@@ -1331,6 +1331,336 @@ class ServeBrownoutScenario(Scenario):
         return failures
 
 
+class ServeMultitenantScenario(Scenario):
+    """Multi-tenant overload survival: quotas, fair queueing, and the
+    class-aware brownout under a 2× scavenger flood
+    (docs/reliability.md "Multi-tenant serving & fairness").
+
+    Three phases over one service on a deterministic tick clock:
+
+    - **U (unloaded)**: an interactive-only wave establishes the
+      unloaded interactive latency baseline.
+    - **O (overload)**: a scavenger flood at 2× its queue quota (the
+      excess must shed class-tagged ``overload`` without consuming
+      interactive headroom — every interactive/batch submit after the
+      flood still admits), mixed with interactive + batch traffic. A
+      *scripted* ``serve.dispatch`` fault — identical in golden and
+      chaos runs — kills exactly the first scavenger batch: because
+      batches are class-pure, the shed hits only scavenger waiters.
+    - **B (brownout)**: a synthetic sick-backend signal (min_evidence
+      is set far above what organic traffic can accumulate, so
+      injected faults can never move the ladder — the transition log's
+      mode path is fault-invariant) forces ``bank_preferred``.
+      Interactive misses must still be answered EXACT (the class-aware
+      ladder leaves interactive at full until severity 2) while
+      scavenger misses come back certified-approximate.
+
+    Scenario oracles: **starvation_bound** (every admitted request
+    resolves within STARVATION_BOUND_S of virtual queue wait, even
+    while lower-priority work dispatches), **class_isolation**
+    (interactive p99 under the flood within ISOLATION_FACTOR of its
+    unloaded p99), **class_batch_purity** (every dispatched batch id —
+    served or shed — carries exactly one class), admission/quota
+    determinism, classified rejections, and the brownout ladder's
+    (from, to, tick) path vs golden. Benign schedules additionally get
+    whole-outcome bit identity from the standard battery.
+    """
+
+    name = "serve_multitenant"
+    MAX_BATCH, MAX_QUEUE = 3, 12
+    N_UNLOADED = 4          # phase-U interactive wave
+    N_FLOOD = 13            # scavenger submits (quota cap is 6 → 2×+)
+    N_OVER_I, N_OVER_B = 4, 2  # interactive/batch riding the flood
+    N_BROWN = 3             # per-class phase-B misses
+    FAULT_ORDINAL = 3       # phase-O dispatch #3 = first scavenger batch
+    STARVATION_BOUND_S = 1.0   # virtual seconds (ticks of 1e-3)
+    ISOLATION_FACTOR = 3.0
+    # exact-path publishes on a shed-free run: 4 (U) + 9 (O, the shed
+    # scavenger batch never publishes) + 3 (B) = 16; approx answers
+    # never publish. Damage is invisible to the outcome (no key is
+    # ever re-read), so the benign domain stays bit-identical.
+    benign_domain = {
+        sites.SERVE_CACHE_PUBLISH: (_DAMAGE_KINDS, 12),
+    }
+    # every planned batch fires serve.dispatch before its device call
+    # (9 fires on the undisturbed run: 2 U + 5 O + 2 B); injected
+    # faults shed exactly the class-pure batch they land on
+    full_domain = {
+        sites.SERVE_DISPATCH: (
+            (taxonomy.WORKER, taxonomy.OOM, taxonomy.DEADLINE), 6),
+        sites.SERVE_CACHE_PUBLISH: (_DAMAGE_KINDS, 4),
+        sites.CHAOS_SCENARIO: ((taxonomy.WORKER,), 1),
+    }
+
+    def __init__(self):
+        import jax
+
+        from fia_tpu.data.dataset import RatingDataset
+        from fia_tpu.influence.engine import InfluenceEngine
+        from fia_tpu.models import MF
+
+        x, y = _toy_data(7, 400)
+        self.model = MF(_U, _I, _K, _WD)
+        self.params = self.model.init_params(jax.random.PRNGKey(0))
+        self.train_ds = RatingDataset(x, y)
+        self.engine = InfluenceEngine(
+            self.model, self.params, self.train_ds, damping=_DAMP,
+            model_name="chaos-multitenant", kernel="xla_analytic")
+        rng = np.random.default_rng(11)
+        flat = rng.choice(_U * _I, size=32, replace=False)
+        keys = [(int(k // _I), int(k % _I)) for k in flat]
+        it = iter(keys)
+
+        def take(n):
+            return [next(it) for _ in range(n)]
+
+        self.unloaded_keys = take(self.N_UNLOADED)
+        self.flood_keys = take(self.N_FLOOD)
+        self.over_i_keys = take(self.N_OVER_I)
+        self.over_b_keys = take(self.N_OVER_B)
+        self.brown_i_keys = take(self.N_BROWN)
+        self.brown_s_keys = take(self.N_BROWN)
+
+    class _TickClock:
+        """Deterministic monotonic stand-in: every read advances one
+        fixed tick, so queue waits measure dispatch ORDER (the thing
+        fair queueing controls), identically across replays."""
+
+        def __init__(self):
+            self.t = 0.0
+
+        def __call__(self):
+            self.t += 1e-3
+            return self.t
+
+    def run(self, workdir: str, events: list) -> dict:
+        import json
+
+        from fia_tpu.serve.health import MODE_BANK_PREFERRED, HealthConfig
+        from fia_tpu.serve.request import Request
+        from fia_tpu.serve.service import InfluenceService, ServeConfig
+
+        eng = self.engine
+        eng.cache_dir = os.path.join(workdir, "cache")
+        svc = InfluenceService(
+            engine=eng,
+            config=ServeConfig(
+                max_batch=self.MAX_BATCH, max_queue=self.MAX_QUEUE,
+                dispatch_window=1,  # scripted fault needs query_batch
+                class_quotas={"scavenger": 0.5},
+                health=HealthConfig(
+                    window=4, err_degrade=0.5, err_cache_only=2.0,
+                    err_recover=0.25, min_evidence=50, queue_hold=3,
+                    hold=8),
+            ),
+            clock=self._TickClock(),
+        )
+        responses = []
+
+        # phase U: unloaded interactive baseline
+        for j, p in enumerate(self.unloaded_keys):
+            svc.submit(Request(*p, id=f"u{j}", cls="interactive",
+                               tenant="t-int"))
+        responses += svc.drain()
+
+        # phase O: 2× scavenger flood + interactive/batch riders. The
+        # flood goes FIRST: its quota rejections prove it cannot eat
+        # the headroom the later interactive/batch submits then use.
+        for j, p in enumerate(self.flood_keys):
+            r = svc.submit(Request(*p, id=f"s{j}", cls="scavenger",
+                                   tenant="t-scav"))
+            if r is not None:
+                responses.append(r)
+        for j, p in enumerate(self.over_i_keys):
+            svc.submit(Request(*p, id=f"i{j}", cls="interactive",
+                               tenant="t-int"))
+        for j, p in enumerate(self.over_b_keys):
+            svc.submit(Request(*p, id=f"m{j}", cls="batch",
+                               tenant="t-bulk"))
+        # scripted serve.dispatch fault, part of the workload itself
+        # (identical in golden and chaos runs): the FAULT_ORDINAL-th
+        # exact dispatch of this drain is the first scavenger batch —
+        # interactive/batch dispatch ahead of it under DRR priority
+        orig_qb = eng.query_batch
+        calls = {"n": 0}
+
+        def scripted(pts):
+            n = calls["n"]
+            calls["n"] += 1
+            if n == self.FAULT_ORDINAL:
+                raise taxonomy.DeadlineExpired(
+                    "scripted chaos fault: scavenger batch dispatch")
+            return orig_qb(pts)
+
+        eng.query_batch = scripted
+        try:
+            responses += svc.drain()
+        finally:
+            eng.query_batch = orig_qb
+
+        # phase B: forced brownout (deterministic synthetic signal; 60
+        # dispatches of evidence meets min_evidence=50 on its own —
+        # organic drains never can)
+        svc.health.observe(errors=60, dispatches=60, queue_depth=0,
+                           queue_cap=svc.admission.max_queue)
+        if svc.health.mode != MODE_BANK_PREFERRED:
+            raise RuntimeError(
+                f"forced brownout did not engage ({svc.health.mode})")
+        events.append({"event": "brownout_forced",
+                       "mode": svc.health.mode})
+        for j, p in enumerate(self.brown_i_keys):
+            svc.submit(Request(*p, id=f"bi{j}", cls="interactive",
+                               tenant="t-int"))
+        for j, p in enumerate(self.brown_s_keys):
+            svc.submit(Request(*p, id=f"bs{j}", cls="scavenger",
+                               tenant="t-scav"))
+        responses += svc.drain()
+
+        out: dict = {"mode": svc.health.mode}
+        for r in responses:
+            out[f"{r.id}:status"] = f"{r.status}/{r.reason or ''}"
+            out[f"{r.id}:class"] = r.cls
+            out[f"{r.id}:wait"] = float(r.queue_wait_s)
+            out[f"{r.id}:batch"] = (-1 if r.batch_id is None
+                                    else int(r.batch_id))
+            out[f"{r.id}:approx"] = int(bool(r.approx))
+            if r.ok:
+                out[f"{r.id}:scores"] = np.asarray(r.scores).copy()
+        # the ladder's mode path must replay identically even under
+        # injected dispatch faults (signal VALUES may differ there;
+        # benign bit-identity covers the full log)
+        out["transitions"] = json.dumps(
+            [(t["from"], t["to"], t["tick"])
+             for t in svc.health.transitions])
+        roll = svc.rollup()
+        out["answered_approx"] = int(roll["answered_approx"])
+        events.append({"event": "serve_rollup",
+                       "classes": roll["classes"],
+                       "rejected": roll["rejected"]})
+        return out
+
+    def _ids(self):
+        return (
+            [f"u{j}" for j in range(self.N_UNLOADED)]
+            + [f"s{j}" for j in range(self.N_FLOOD)]
+            + [f"i{j}" for j in range(self.N_OVER_I)]
+            + [f"m{j}" for j in range(self.N_OVER_B)]
+            + [f"bi{j}" for j in range(self.N_BROWN)]
+            + [f"bs{j}" for j in range(self.N_BROWN)]
+        )
+
+    def check(self, golden: dict, record) -> list:
+        from fia_tpu.chaos.oracles import OracleFailure
+        from fia_tpu.serve import admission
+
+        if record.error is not None or record.outcome is None:
+            return []
+        got = record.outcome
+        failures = []
+        allowed = {
+            taxonomy.OOM, taxonomy.HOST_OOM, taxonomy.AMBIGUOUS,
+            taxonomy.WORKER, taxonomy.PREEMPTION, taxonomy.NAN,
+            taxonomy.DEADLINE, taxonomy.DEVICE_LOST,
+            admission.REASON_OVERLOAD, admission.REASON_INVALID,
+            admission.REASON_DEGRADED,
+        }
+        admission_reasons = ("/" + admission.REASON_OVERLOAD,
+                            "/" + admission.REASON_INVALID)
+        waits_unloaded, waits_overload = [], []
+        by_batch: dict[int, set] = {}
+        for rid in self._ids():
+            status = str(got.get(f"{rid}:status", "<missing>"))
+            if status == "<missing>":
+                failures.append(OracleFailure(
+                    "starvation_bound",
+                    f"{rid}: admitted request never resolved",
+                ))
+                continue
+            gs = str(golden.get(f"{rid}:status", "<missing>"))
+            # admission decisions are a pure function of the submit
+            # stream + quotas — faults cannot move them
+            for adm in admission_reasons:
+                if gs.endswith(adm) != status.endswith(adm):
+                    failures.append(OracleFailure(
+                        "admission_determinism",
+                        f"{rid}: golden {gs} vs chaos {status}",
+                    ))
+            if status.startswith("rejected/"):
+                reason = status.split("/", 1)[1]
+                if reason not in allowed:
+                    failures.append(OracleFailure(
+                        "classified_rejection",
+                        f"{rid}: unclassified rejection {reason!r}",
+                    ))
+                if reason in (admission.REASON_OVERLOAD,
+                              admission.REASON_INVALID):
+                    continue  # refused at the door: no wait to bound
+            # admitted (served, or admitted-then-shed): bounded wait
+            wait = float(got.get(f"{rid}:wait", 0.0))
+            if wait > self.STARVATION_BOUND_S:
+                failures.append(OracleFailure(
+                    "starvation_bound",
+                    f"{rid}: queue wait {wait:.3f}s exceeds the "
+                    f"{self.STARVATION_BOUND_S}s starvation bound",
+                ))
+            if rid.startswith("u"):
+                waits_unloaded.append(wait)
+            elif rid.startswith("i"):
+                waits_overload.append(wait)
+            bid = int(got.get(f"{rid}:batch", -1))
+            if bid >= 0:
+                by_batch.setdefault(bid, set()).add(
+                    str(got.get(f"{rid}:class")))
+        for bid, classes in sorted(by_batch.items()):
+            if len(classes) != 1:
+                failures.append(OracleFailure(
+                    "class_batch_purity",
+                    f"batch {bid} mixes classes {sorted(classes)} — "
+                    "a fault there cannot shed a single class",
+                ))
+        if waits_unloaded and waits_overload:
+            p99_u = float(np.percentile(waits_unloaded, 99))
+            p99_o = float(np.percentile(waits_overload, 99))
+            if p99_o > self.ISOLATION_FACTOR * max(p99_u, 1e-9):
+                failures.append(OracleFailure(
+                    "class_isolation",
+                    f"interactive p99 under 2× scavenger overload "
+                    f"({p99_o:.4f}s) exceeds {self.ISOLATION_FACTOR}× "
+                    f"its unloaded p99 ({p99_u:.4f}s)",
+                ))
+        if str(got.get("transitions")) != str(golden.get("transitions")):
+            failures.append(OracleFailure(
+                "brownout_replay",
+                f"ladder mode path diverged: golden "
+                f"{golden.get('transitions')} vs chaos "
+                f"{got.get('transitions')}",
+            ))
+        # the class-aware ladder: phase-B interactive misses answer
+        # EXACT; scavenger misses answer certified-approximate (an
+        # injected dispatch fault may shed them classified instead —
+        # but an answered one must carry the right stamp)
+        for j in range(self.N_BROWN):
+            bi = f"bi{j}"
+            if (str(got.get(f"{bi}:status")) == "ok/"
+                    and int(got.get(f"{bi}:approx", 0))):
+                failures.append(OracleFailure(
+                    "class_aware_brownout",
+                    f"{bi}: interactive answered approximate at "
+                    "severity 1 — interactive degrades only at "
+                    "severity 2",
+                ))
+            bs = f"bs{j}"
+            if (str(got.get(f"{bs}:status")) == "ok/"
+                    and not int(got.get(f"{bs}:approx", 0))):
+                failures.append(OracleFailure(
+                    "class_aware_brownout",
+                    f"{bs}: scavenger brownout miss answered exact — "
+                    "the sampled rung must absorb scavenger work "
+                    "first",
+                ))
+        return failures
+
+
 def make_scenarios() -> dict:
     """Fresh scenario registry (instances are lazily constructed so the
     selftest path never imports jax)."""
@@ -1345,6 +1675,7 @@ def make_scenarios() -> dict:
         FactorBankScenario.name: FactorBankScenario,
         UpdateWhileServingScenario.name: UpdateWhileServingScenario,
         ServeBrownoutScenario.name: ServeBrownoutScenario,
+        ServeMultitenantScenario.name: ServeMultitenantScenario,
     }
 
 

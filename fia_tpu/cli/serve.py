@@ -79,12 +79,39 @@ def add_serve_flags(p):
     p.add_argument("--smoke_hot_frac", type=float, default=0.5,
                    help="smoke stream: fraction of requests drawn from "
                         "a small hot set of repeated queries")
+    p.add_argument("--class_quota", action="append", default=None,
+                   metavar="CLASS=FRAC",
+                   help="per-class queue quota as a fraction of "
+                        "--max_queue (repeatable, e.g. "
+                        "--class_quota scavenger=0.25); defaults keep "
+                        "interactive/batch at 1.0 and scavenger at 0.5")
+    p.add_argument("--class_weight", action="append", default=None,
+                   metavar="CLASS=W",
+                   help="fair-queueing DRR weight per class "
+                        "(repeatable; defaults interactive=8 batch=3 "
+                        "scavenger=1)")
+    p.add_argument("--smoke_class_mix", type=str, default="",
+                   help="smoke stream tenant mix, e.g. "
+                        "'interactive=0.2,batch=0.5,scavenger=0.3' "
+                        "(empty = unclassed legacy stream)")
     p.add_argument("--trace", type=int, default=0,
                    help="1: per-request span tracing — obs.span lines "
                         "interleave into the metrics JSONL; render with "
                         "python -m fia_tpu.cli.obs "
                         "(docs/observability.md)")
     return p
+
+
+def _parse_class_kv(pairs, cast) -> dict | None:
+    """``["scavenger=0.25", ...]`` → {"scavenger": 0.25} (None in/out
+    passthrough; validation happens in the serve layer)."""
+    if not pairs:
+        return None
+    out = {}
+    for kv in pairs:
+        k, _, v = kv.partition("=")
+        out[k.strip()] = cast(v)
+    return out
 
 
 def build_service(args):
@@ -120,6 +147,10 @@ def build_service(args):
         default_deadline_s=args.request_deadline or None,
         disk_cache=bool(args.disk_cache), metrics_path=metrics,
         mesh=mesh,
+        class_quotas=_parse_class_kv(
+            getattr(args, "class_quota", None), float),
+        class_weights=_parse_class_kv(
+            getattr(args, "class_weight", None), int),
     )
     try:
         svc = InfluenceService(engine=engine, config=cfg)
@@ -145,33 +176,54 @@ def parse_request(line: str) -> Request | None:
         return None
     if line.startswith("{"):
         d = json.loads(line)
+        kw = {}
+        if d.get("class") is not None:
+            kw["cls"] = str(d["class"])
+        if d.get("tenant") is not None:
+            kw["tenant"] = str(d["tenant"])
         return Request(user=int(d["user"]), item=int(d["item"]),
-                       id=d.get("id"), deadline_s=d.get("deadline_s"))
+                       id=d.get("id"), deadline_s=d.get("deadline_s"),
+                       **kw)
     parts = line.split()
     return Request(user=int(parts[0]), item=int(parts[1]))
 
 
-def smoke_stream(test_x, n: int, hot_frac: float, seed: int):
+def smoke_stream(test_x, n: int, hot_frac: float, seed: int,
+                 class_mix: str = ""):
     """A repeat-heavy synthetic request stream over the test split:
     ``hot_frac`` of requests revisit a small hot set (what a real
     serving workload looks like, and what makes hot-tier hits
-    assertable)."""
+    assertable). ``class_mix`` ('cls=frac,...') samples a priority
+    class per request from the given distribution; empty keeps the
+    unclassed legacy stream."""
     rng = np.random.default_rng(seed)
     hot = test_x[rng.choice(len(test_x), size=max(4, n // 25),
                             replace=False)]
+    classes, probs = None, None
+    if class_mix:
+        mix = _parse_class_kv(class_mix.split(","), float)
+        classes = list(mix)
+        total = sum(mix.values())
+        probs = [mix[c] / total for c in classes]
     out = []
     for k in range(n):
         if rng.random() < hot_frac:
             u, i = hot[rng.integers(len(hot))]
         else:
             u, i = test_x[rng.integers(len(test_x))]
-        out.append(Request(user=int(u), item=int(i), id=f"smoke{k}"))
+        kw = {}
+        if classes:
+            kw["cls"] = classes[int(rng.choice(len(classes), p=probs))]
+            kw["tenant"] = f"t-{kw['cls']}"
+        out.append(Request(user=int(u), item=int(i), id=f"smoke{k}",
+                           **kw))
     return out
 
 
 def run_smoke(svc: InfluenceService, splits, args) -> int:
     reqs = smoke_stream(np.asarray(splits["test"].x), args.smoke_requests,
-                        args.smoke_hot_frac, args.seed)
+                        args.smoke_hot_frac, args.seed,
+                        class_mix=getattr(args, "smoke_class_mix", ""))
     responses = svc.run(reqs, drain_every=args.max_batch)
     report = svc.close()
     print(json.dumps({"event": "serve.smoke", **report}))
@@ -190,6 +242,9 @@ def run_smoke(svc: InfluenceService, splits, args) -> int:
                         "stream")
     if report["ok"] + sum(report["rejected"].values()) != len(reqs):
         failures.append("request accounting does not add up")
+    for cls, lane in report.get("classes", {}).items():
+        if lane["ok"] + sum(lane["rejected"].values()) != lane["requests"]:
+            failures.append(f"class {cls!r} accounting does not add up")
     for f in failures:
         print(f"SMOKE FAIL: {f}", file=sys.stderr)
     if not failures:

@@ -24,6 +24,7 @@ Layers (each its own module, composable without the service):
 """
 
 from fia_tpu.serve.admission import (  # noqa: F401
+    DEFAULT_CLASS_QUOTAS,
     REASON_DEADLINE,
     REASON_DEGRADED,
     REASON_INVALID,
@@ -39,6 +40,18 @@ from fia_tpu.serve.health import (  # noqa: F401
     HealthController,
 )
 from fia_tpu.serve.metrics import ServeMetrics  # noqa: F401
-from fia_tpu.serve.request import Request, Response  # noqa: F401
-from fia_tpu.serve.scheduler import MicroBatcher  # noqa: F401
+from fia_tpu.serve.request import (  # noqa: F401
+    CLASS_BATCH,
+    CLASS_INTERACTIVE,
+    CLASS_SCAVENGER,
+    CLASSES,
+    DEFAULT_CLASS,
+    Request,
+    Response,
+)
+from fia_tpu.serve.scheduler import (  # noqa: F401
+    CLASS_WEIGHTS,
+    FairScheduler,
+    MicroBatcher,
+)
 from fia_tpu.serve.service import InfluenceService, ServeConfig  # noqa: F401

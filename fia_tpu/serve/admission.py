@@ -18,7 +18,7 @@ replayed request stream sheds exactly the same requests.
 from __future__ import annotations
 
 from fia_tpu.reliability import taxonomy
-from fia_tpu.serve.request import Request, Ticket
+from fia_tpu.serve.request import CLASSES, Request, Ticket
 
 # Rejection reasons. DEADLINE is the taxonomy kind (a request whose
 # budget expired is the same failure class as a Deadline-guarded
@@ -30,6 +30,18 @@ REASON_DEADLINE = taxonomy.DEADLINE
 REASON_OVERLOAD = "overload"
 REASON_INVALID = "invalid"
 REASON_DEGRADED = "degraded"
+
+# Per-class queue quotas as fractions of max_queue. The defaults keep
+# the pre-multi-tenant behaviour for interactive/batch (full queue)
+# and cap only the new scavenger class, so a scavenger flood can never
+# evict interactive/batch headroom; stricter isolation is opt-in via
+# ServeConfig.class_quotas. A class's quota bounds how many of ITS
+# tickets may wait — the total queue bound still applies on top.
+DEFAULT_CLASS_QUOTAS = {
+    "interactive": 1.0,
+    "batch": 1.0,
+    "scavenger": 0.5,
+}
 
 
 class AdmissionController:
@@ -43,20 +55,42 @@ class AdmissionController:
     ``num_users``/``num_items``: id-range validation — an out-of-range
     id must be refused at the door, not discovered as a host-side
     IndexError inside a coalesced batch dispatch.
+    ``class_quotas``: per-class queue quota fractions merged over
+    ``DEFAULT_CLASS_QUOTAS`` — each class's waiting tickets are bounded
+    by ``max(1, round(frac * max_queue))`` so a lower-priority flood
+    fills only its own lane.
     """
 
     def __init__(self, max_queue: int = 256,
                  default_deadline_s: float | None = None,
                  num_users: int | None = None,
-                 num_items: int | None = None):
+                 num_items: int | None = None,
+                 class_quotas: dict[str, float] | None = None):
         self.max_queue = max(int(max_queue), 1)
         self.default_deadline_s = default_deadline_s
         self.num_users = num_users
         self.num_items = num_items
+        quotas = dict(DEFAULT_CLASS_QUOTAS)
+        quotas.update(class_quotas or {})
+        for cls, frac in quotas.items():
+            if cls not in CLASSES:
+                raise ValueError(f"class_quotas names unknown class "
+                                 f"{cls!r} (know {CLASSES})")
+            if not 0.0 < float(frac) <= 1.0:
+                raise ValueError(
+                    f"class quota for {cls!r} must be in (0, 1], "
+                    f"got {frac}")
+        self.class_caps = {
+            cls: max(1, int(round(float(frac) * self.max_queue)))
+            for cls, frac in quotas.items()
+        }
 
-    def reject_reason(self, req: Request, queue_depth: int) -> str | None:
+    def reject_reason(self, req: Request, queue_depth: int,
+                      class_depth: int = 0) -> str | None:
         """The rejection reason for ``req`` at ``queue_depth``, or None
-        when it is admitted."""
+        when it is admitted. ``class_depth`` is the count of queued
+        tickets already in ``req``'s class (0 keeps the single-tenant
+        behaviour: only the total bound applies)."""
         u, i = int(req.user), int(req.item)
         if u < 0 or i < 0:
             return REASON_INVALID
@@ -64,7 +98,11 @@ class AdmissionController:
             return REASON_INVALID
         if self.num_items is not None and i >= self.num_items:
             return REASON_INVALID
+        if req.cls not in CLASSES:
+            return REASON_INVALID
         if queue_depth >= self.max_queue:
+            return REASON_OVERLOAD
+        if class_depth >= self.class_caps[req.cls]:
             return REASON_OVERLOAD
         return None
 

@@ -52,6 +52,12 @@ from __future__ import annotations
 from collections import deque
 from dataclasses import dataclass
 
+from fia_tpu.serve.request import (
+    CLASS_INTERACTIVE,
+    CLASS_SCAVENGER,
+    DEFAULT_CLASS,
+)
+
 MODE_FULL = "full"
 MODE_BANK_PREFERRED = "bank_preferred"
 MODE_CACHE_ONLY = "cache_only"
@@ -203,17 +209,48 @@ class HealthController:
         self.mode = to
 
     # -- mode predicates the service consults -----------------------------
-    def allows_solve(self) -> bool:
-        """May a miss take a from-scratch ladder solve?"""
-        return self.mode == MODE_FULL
+    # Class-aware brownout (docs/reliability.md "Multi-tenant serving &
+    # fairness"): the ladder position is GLOBAL (one signal history, one
+    # transition log — replay determinism is unchanged) but each rung
+    # degrades the classes in reverse priority order. At severity 1
+    # (bank_preferred) interactive traffic still takes full ladder
+    # solves — it sheds only at severity 2 — while batch browns out to
+    # bank/approx and scavenger loses the bank tier too (approx or
+    # shed: the cheap-tier capacity the bank preserves is exactly the
+    # headroom the brownout protects for higher classes). Severity 2
+    # (cache_only) is the exhaustion floor for everyone. The default
+    # ``cls`` is the legacy/batch class, so every pre-multi-tenant
+    # call site keeps its PR-10 semantics bit-for-bit.
+    def class_mode(self, cls: str = DEFAULT_CLASS) -> str:
+        """The effective serving mode ``cls`` experiences under the
+        current global ladder position."""
+        if self.mode == MODE_FULL:
+            return MODE_FULL
+        if self.mode == MODE_CACHE_ONLY:
+            return MODE_CACHE_ONLY
+        # global bank_preferred: interactive rides above the brownout
+        if cls == CLASS_INTERACTIVE:
+            return MODE_FULL
+        return MODE_BANK_PREFERRED
 
-    def allows_bank(self) -> bool:
-        """May a miss take the O(1) precomputed-bank path?"""
-        return self.mode in (MODE_FULL, MODE_BANK_PREFERRED)
+    def allows_solve(self, cls: str = DEFAULT_CLASS) -> bool:
+        """May a miss of ``cls`` take a from-scratch ladder solve?"""
+        return self.class_mode(cls) == MODE_FULL
 
-    def allows_approx(self) -> bool:
-        """May a brownout miss serve a certified approximate answer
-        (the ``sampled`` rung) instead of shedding? ``cache_only`` is
-        the exhaustion floor — by then the backend is failing most
-        dispatches and even a subsampled solve is work it cannot do."""
-        return self.config.approx_ok and self.mode == MODE_BANK_PREFERRED
+    def allows_bank(self, cls: str = DEFAULT_CLASS) -> bool:
+        """May a miss of ``cls`` take the O(1) precomputed-bank path?
+        Scavenger loses it one rung early: under brownout the bank's
+        O(1) capacity is reserved for the classes above."""
+        if self.class_mode(cls) == MODE_CACHE_ONLY:
+            return False
+        return not (self.mode != MODE_FULL and cls == CLASS_SCAVENGER)
+
+    def allows_approx(self, cls: str = DEFAULT_CLASS) -> bool:
+        """May a brownout miss of ``cls`` serve a certified approximate
+        answer (the ``sampled`` rung) instead of shedding?
+        ``cache_only`` is the exhaustion floor — by then the backend is
+        failing most dispatches and even a subsampled solve is work it
+        cannot do. Interactive never answers approx: its contract is
+        exact-or-shed."""
+        return (self.config.approx_ok
+                and self.class_mode(cls) == MODE_BANK_PREFERRED)

@@ -10,7 +10,8 @@ record carries ``t``, a wall-clock epoch-seconds stamp):
      "overload"|"invalid"|<taxonomy kind>, "tier": null|"hot"|"disk"|
      "compute", "queue_wait_ms": f, "solve_ms": f,
      "batch_id": n|null, "batch_size": n|null,
-     "approx": bool, "err_bound": f|null}
+     "approx": bool, "err_bound": f|null,
+     "class": "interactive"|"batch"|"scavenger", "tenant": s|null}
 
 ``approx``/``err_bound`` are the certified-approximate stamp
 (docs/design.md §22): True marks an answer served from the subsampled
@@ -32,7 +33,9 @@ bound on the per-row score error. Exact answers log ``false``/null.
      "solve_ms": {"p50": f, "p95": f, "max": f},
      "batches": n, "mean_batch_size": f, "cache": {...},
      "modes": {mode: n}, "mode_transitions": n,
-     "device_loss_recoveries": n, "answered_approx": n}
+     "device_loss_recoveries": n, "answered_approx": n,
+     "classes": {cls: {"requests": n, "ok": n, "rejected": {reason: n},
+                       "answered_approx": n, "queue_wait_ms": {...}}}}
 
 ``serve.mode`` — one line per brownout-ladder transition
 (docs/reliability.md "Degraded modes")::
@@ -65,7 +68,7 @@ SCHEMA = {
     "serve.request": (
         "id", "user", "item", "status", "reason", "tier",
         "queue_wait_ms", "solve_ms", "batch_id", "batch_size", "mode",
-        "approx", "err_bound",
+        "approx", "err_bound", "class", "tenant",
     ),
     "serve.batch": (
         "batch_id", "size", "total_rows", "solve_ms", "status",
@@ -74,7 +77,7 @@ SCHEMA = {
         "requests", "ok", "rejected", "tiers", "hot_hit_rate",
         "queue_wait_ms", "solve_ms", "batches", "mean_batch_size",
         "cache", "modes", "mode_transitions", "device_loss_recoveries",
-        "answered_approx",
+        "answered_approx", "classes",
     ),
     # one line per brownout-ladder transition (serve/health.py): the
     # windowed signal values that drove the step, for post-mortems
@@ -126,6 +129,10 @@ class ServeMetrics:
         self.device_loss_recoveries = 0
         self.answered_approx = 0
         self.err_bounds: list[float] = []  # stamped bounds, ok+approx
+        # per-class accounting (multi-tenant rollup "classes" block):
+        # class -> {"requests", "ok", "rejected": {reason: n},
+        #           "approx", queue-wait samples}
+        self.by_class: dict[str, dict] = {}
 
     def record_request(self, resp: Response) -> None:
         self.by_status[resp.status] = self.by_status.get(resp.status, 0) + 1
@@ -142,6 +149,22 @@ class ServeMetrics:
         if resp.ok:
             self.queue_wait_ms.append(resp.queue_wait_s * 1e3)
             self.solve_ms.append(resp.solve_s * 1e3)
+        # per-class lane accounting (the multi-tenant fairness surface)
+        cls = resp.cls or "none"
+        lane = self.by_class.setdefault(cls, {
+            "requests": 0, "ok": 0, "rejected": {}, "approx": 0,
+            "queue_wait_ms": [],
+        })
+        lane["requests"] += 1
+        if resp.ok:
+            lane["ok"] += 1
+            lane["queue_wait_ms"].append(resp.queue_wait_s * 1e3)
+            if resp.approx:
+                lane["approx"] += 1
+        elif resp.reason:
+            lane["rejected"][resp.reason] = (
+                lane["rejected"].get(resp.reason, 0) + 1
+            )
         # mirror into the process-wide obs registry: the per-rung /
         # per-mode µs histograms scripts/latency_report.py renders
         # p50/p99 from (via the obs.metrics snapshot line)
@@ -152,6 +175,9 @@ class ServeMetrics:
         if resp.reason:
             REGISTRY.counter(
                 "serve.rejects_total", reason=resp.reason).inc()
+            REGISTRY.counter(
+                "serve.rejects_by_class_total",
+                **{"reason": resp.reason, "class": cls}).inc()
         if resp.ok and resp.approx:
             # certified-approximate answers (the sampled rung): counted
             # per mode so brownout salvage is visible next to the
@@ -170,6 +196,15 @@ class ServeMetrics:
             ).observe(resp.solve_s * 1e6)
             REGISTRY.histogram(
                 "serve.solve_by_solver_us", solver=solver
+            ).observe(resp.solve_s * 1e6)
+            # class-labelled twins of the latency histograms: NEW
+            # series (the mode/solver-labelled ones above are a pinned
+            # surface), rendered per class by scripts/latency_report.py
+            REGISTRY.histogram(
+                "serve.queue_wait_by_class_us", **{"class": cls}
+            ).observe(resp.queue_wait_s * 1e6)
+            REGISTRY.histogram(
+                "serve.solve_by_class_us", **{"class": cls}
             ).observe(resp.solve_s * 1e6)
             if resp.cache_tier:
                 REGISTRY.counter(
@@ -227,6 +262,18 @@ class ServeMetrics:
             "mode_transitions": self.mode_transitions,
             "device_loss_recoveries": self.device_loss_recoveries,
             "answered_approx": self.answered_approx,
+            # per-class lanes: the same accounting identity holds per
+            # class (requests == ok + Σ rejected within each lane)
+            "classes": {
+                cls: {
+                    "requests": lane["requests"],
+                    "ok": lane["ok"],
+                    "rejected": dict(lane["rejected"]),
+                    "answered_approx": lane["approx"],
+                    "queue_wait_ms": _pcts(lane["queue_wait_ms"]),
+                }
+                for cls, lane in sorted(self.by_class.items())
+            },
         }
         if cache_stats is not None:
             out["cache"] = dict(cache_stats)

@@ -29,6 +29,18 @@ TIER_PRECOMPUTED = "precomputed"
 STATUS_OK = "ok"
 STATUS_REJECTED = "rejected"
 
+# Priority classes (multi-tenant serving, docs/reliability.md
+# "Multi-tenant serving & fairness"). Order = priority: interactive
+# dispatches ahead of batch ahead of scavenger under the fair-queueing
+# scheduler, and the brownout ladder degrades the tail first.
+# Unclassed requests are `batch` — the pre-multi-tenant behaviour
+# (full brownout/approx semantics) unchanged.
+CLASS_INTERACTIVE = "interactive"
+CLASS_BATCH = "batch"
+CLASS_SCAVENGER = "scavenger"
+CLASSES = (CLASS_INTERACTIVE, CLASS_BATCH, CLASS_SCAVENGER)
+DEFAULT_CLASS = CLASS_BATCH
+
 
 @dataclass
 class Request:
@@ -40,6 +52,13 @@ class Request:
     # wall-clock budget in seconds, measured from arrival; None adopts
     # the service default (ServeConfig.default_deadline_s)
     deadline_s: float | None = None
+    # priority class ("interactive" | "batch" | "scavenger") — drives
+    # admission quotas, fair-queueing weight, and the class-aware
+    # brownout ladder; an unknown class is rejected "invalid" at the
+    # door. JSON wire key: "class".
+    cls: str = DEFAULT_CLASS
+    # opaque tenant label for per-tenant accounting; never interpreted
+    tenant: str | None = None
 
     def key(self) -> tuple[int, int]:
         return (int(self.user), int(self.item))
@@ -92,6 +111,11 @@ class Response:
     # defaults, so absence reads as exactness.
     approx: bool = False
     err_bound: float | None = None
+    # priority class and tenant echoed from the request (wire keys
+    # "class"/"tenant") — every answer AND every rejection says which
+    # tenant lane produced it
+    cls: str = DEFAULT_CLASS
+    tenant: str | None = None
     extra: dict = field(default_factory=dict)
 
     @property
@@ -115,6 +139,8 @@ class Response:
             "approx": bool(self.approx),
             "err_bound": (None if self.err_bound is None
                           else float(self.err_bound)),
+            "class": self.cls,
+            "tenant": self.tenant,
         }
         if include_payload and self.scores is not None:
             out["scores"] = np.asarray(self.scores).tolist()

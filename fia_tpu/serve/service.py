@@ -61,6 +61,7 @@ from fia_tpu.serve.health import (
 )
 from fia_tpu.serve.metrics import ServeMetrics
 from fia_tpu.serve.request import (
+    CLASSES,
     STATUS_REJECTED,
     TIER_COMPUTE,
     TIER_DISK,
@@ -70,7 +71,7 @@ from fia_tpu.serve.request import (
     Response,
     Ticket,
 )
-from fia_tpu.serve.scheduler import MicroBatcher
+from fia_tpu.serve.scheduler import FairScheduler, MicroBatcher
 
 
 @dataclass
@@ -111,6 +112,18 @@ class ServeConfig:
     factor_bank: bool = True
     # Brownout-ladder thresholds (serve/health.py); None = defaults.
     health: HealthConfig | None = None
+    # Multi-tenant knobs (docs/reliability.md "Multi-tenant serving &
+    # fairness"). class_quotas: per-class queue quota fractions merged
+    # over admission.DEFAULT_CLASS_QUOTAS; class_weights: DRR weights
+    # merged over scheduler.CLASS_WEIGHTS. None = defaults (unclassed
+    # streams behave exactly as before the multi-tenant layer).
+    class_quotas: dict | None = None
+    class_weights: dict | None = None
+    # Deadline-aware packing: a queued request whose remaining budget
+    # is at or under this slack promotes its batch to the front of a
+    # multi-class plan. None disables the promotion (single-class
+    # plans are never reordered — that order is the pinned contract).
+    deadline_slack_s: float | None = None
 
 
 def _approx_extra(res, row: int) -> dict:
@@ -172,6 +185,10 @@ class InfluenceService:
             self.config.max_batch, self.config.coalesce,
             pad_bucket=int(getattr(self._peek_engine(), "pad_bucket", 128)),
         )
+        # fair-queueing over per-class lanes; single-class streams pass
+        # through to the wrapped batcher verbatim (byte identity)
+        self.scheduler = FairScheduler(self.batcher,
+                                       self.config.class_weights)
         eng = self._peek_engine()
         self.mesh = _resolve_mesh(self.config.mesh)
         if self.mesh is not None:
@@ -206,8 +223,12 @@ class InfluenceService:
             default_deadline_s=self.config.default_deadline_s,
             num_users=eng.model.num_users,
             num_items=eng.model.num_items,
+            class_quotas=self.config.class_quotas,
         )
         self._queue: list[Ticket] = []
+        # queued tickets per class (admission quota signal) — rebuilt
+        # to empty when a drain swaps the queue out
+        self._class_depth: dict[str, int] = {}
         self._next_id = 0
         self._batch_id = 0
         self._fp_cache: tuple | None = None  # (engine identity, digest)
@@ -351,12 +372,16 @@ class InfluenceService:
         if req.id is None:
             req.id = f"r{self._next_id}"
         self._next_id += 1
-        reason = self.admission.reject_reason(req, len(self._queue))
+        reason = self.admission.reject_reason(
+            req, len(self._queue),
+            class_depth=self._class_depth.get(req.cls, 0),
+        )
         if reason is not None:
             resp = Response(
                 id=req.id, user=req.user, item=req.item,
                 status=STATUS_REJECTED, reason=reason,
                 mode=self.health.mode,
+                cls=req.cls, tenant=req.tenant,
             )
             self.metrics.record_request(resp)
             self._trace_request(resp, self.clock())
@@ -365,6 +390,7 @@ class InfluenceService:
         t = self.admission.ticket(req, self.clock())
         t.epoch = self._epoch
         self._queue.append(t)
+        self._class_depth[req.cls] = self._class_depth.get(req.cls, 0) + 1
         return None
 
     @property
@@ -409,6 +435,7 @@ class InfluenceService:
     def _drain_impl(self) -> list[Response]:
         depth = len(self._queue)  # health signal: occupancy at drain start
         work, self._queue = self._queue, []
+        self._class_depth = {}
         now = self.clock()
         # the mode is FIXED for the whole drain (self.health only moves
         # in the observe() below) — within-drain decisions stay a pure
@@ -493,8 +520,7 @@ class InfluenceService:
         now = self.clock()
         # cache tiers first; misses keep first-arrival order per key
         misses: dict[tuple, list[tuple[int, Ticket]]] = {}
-        approx_hot = (self.health.allows_approx()
-                      and eng.solver != "sampled")
+        exact_solver = eng.solver != "sampled"
         for pos, t in live:
             key = (fp, eng.solver) + t.req.key()
             entry = self.cache.get(key)
@@ -506,7 +532,7 @@ class InfluenceService:
                 self.cache.put(key, entry)
                 responses[pos] = self._respond(t, entry, TIER_DISK, now, eng)
                 continue
-            if approx_hot:
+            if exact_solver and self.health.allows_approx(t.req.cls):
                 # a certified answer banked by an earlier brownout drain
                 # (hot tier only, under the sampled sibling's solver key
                 # — the exact key space above stays byte-untouched)
@@ -530,33 +556,50 @@ class InfluenceService:
         if approx:
             self._dispatch_approx(eng, fp, approx, responses)
 
-    def _shed_degraded(self, eng, misses, responses) -> tuple[dict, dict]:
-        """Brownout: route each miss where the active mode may serve it.
+    @staticmethod
+    def _key_class(waiting) -> str:
+        """The class a miss key is served under: the highest-priority
+        class among its coalesced waiters (a duplicate key shared by an
+        interactive and a scavenger waiter dispatches as interactive —
+        de-duplication must never demote the urgent one)."""
+        return min((t.req.cls for _, t in waiting),
+                   key=lambda c: CLASSES.index(c))
 
-        ``bank_preferred`` keeps misses the precomputed factor bank
-        answers in O(1) (a triangular solve against resident factors —
-        docs/design.md §14, unchanged bytes vs full mode); misses the
-        bank cannot answer serve a certified approximate answer from
-        the engine's ``sampled`` sibling when the mode allows it
-        (``health.allows_approx()``) and are rejected ``degraded``
-        otherwise. In ``cache_only`` — or with ``approx_ok`` off —
-        every unbanked miss is shed ``degraded``: that mode is the
-        exhaustion floor. Hits never reach here: degraded modes shed
-        only miss-path work. Returns ``(bank_misses, approx_misses)``.
+    def _shed_degraded(self, eng, misses, responses) -> tuple[dict, dict]:
+        """Brownout: route each miss where the active mode may serve
+        its class (serve/health.py class_mode — the ladder degrades
+        scavenger → batch → interactive in order).
+
+        Per miss key, under the highest-priority waiter's class:
+        classes the global rung leaves at ``full`` (interactive at
+        ``bank_preferred``) keep their exact ladder solve; degraded
+        classes keep misses the precomputed factor bank answers in
+        O(1) where the class may still use it (docs/design.md §14,
+        unchanged bytes vs full mode — scavenger loses the bank one
+        rung early); the rest serve a certified approximate answer
+        from the engine's ``sampled`` sibling when
+        ``health.allows_approx(cls)`` says so, and are rejected
+        ``degraded`` otherwise. In ``cache_only`` — or with
+        ``approx_ok`` off — every unbanked miss is shed ``degraded``:
+        that rung is the exhaustion floor for every class. Hits never
+        reach here: degraded modes shed only miss-path work. Returns
+        ``(exact_misses, approx_misses)``.
         """
-        bank_ok = (
-            self.health.allows_bank()
-            and eng.solver == "precomputed"
+        bank_loaded = (
+            eng.solver == "precomputed"
             and eng.ensure_factor_bank() > 0
         )
-        approx_ok = self.health.allows_approx()
         keep: dict[tuple, list] = {}
         approx: dict[tuple, list] = {}
         now = self.clock()
         for key, waiting in misses.items():
-            if bank_ok and eng.bank_contains(key[2], key[3]):
+            cls = self._key_class(waiting)
+            if self.health.allows_solve(cls):
                 keep[key] = waiting
-            elif approx_ok:
+            elif (bank_loaded and self.health.allows_bank(cls)
+                  and eng.bank_contains(key[2], key[3])):
+                keep[key] = waiting
+            elif self.health.allows_approx(cls):
                 approx[key] = waiting
             else:
                 for pos, t in waiting:
@@ -578,11 +621,30 @@ class InfluenceService:
             and not eng._multihost
         )
 
+    def _miss_lanes(self, misses, keys) -> tuple[list, list | None]:
+        """(classes, urgent) scheduler inputs for a miss-key list:
+        per key, the highest-priority waiter's class, and whether any
+        waiter's remaining deadline budget is inside the configured
+        slack (None when deadline promotion is disabled)."""
+        classes = [self._key_class(misses[k]) for k in keys]
+        slack = self.config.deadline_slack_s
+        if slack is None:
+            return classes, None
+        now = self.clock()
+        urgent = [
+            any(t.t_deadline is not None
+                and (t.t_deadline - now) <= float(slack)
+                for _, t in misses[k])
+            for k in keys
+        ]
+        return classes, urgent
+
     def _dispatch_misses(self, eng, fp, misses, responses) -> None:
         keys = list(misses.keys())  # first-arrival order (dict insertion)
         points = np.asarray([[k[2], k[3]] for k in keys], np.int64)
         counts = eng.index.counts_batch(points)
-        plan = self.batcher.plan(counts)
+        classes, urgent = self._miss_lanes(misses, keys)
+        plan = self.scheduler.plan(counts, classes, urgent)
         if not self._overlap_eligible(eng):
             for batch in plan:
                 self._dispatch_one(eng, fp, misses, responses, keys,
@@ -838,7 +900,8 @@ class InfluenceService:
         keys = list(misses.keys())
         points = np.asarray([[k[2], k[3]] for k in keys], np.int64)
         counts = eng.index.counts_batch(points)
-        for batch in self.batcher.plan(counts):
+        classes, urgent = self._miss_lanes(misses, keys)
+        for batch in self.scheduler.plan(counts, classes, urgent):
             bid = self._batch_id
             self._batch_id += 1
             self.dispatch_log.append((bid, np.array(points[batch])))
@@ -906,6 +969,7 @@ class InfluenceService:
             queue_wait_s=max(now - t.t_arrival, 0.0), solve_s=solve_s,
             batch_id=batch_id, batch_size=batch_size,
             mode=self.health.mode,
+            cls=t.req.cls, tenant=t.req.tenant,
             # certificate provenance rides the cached entry, so hot/disk
             # hits of an approximate block keep their stamped bound
             approx=bool(entry.extra.get("approx", False)),
@@ -924,6 +988,7 @@ class InfluenceService:
             queue_wait_s=max(now - t.t_arrival, 0.0),
             batch_id=batch_id, batch_size=batch_size,
             mode=self.health.mode,
+            cls=t.req.cls, tenant=t.req.tenant,
         )
 
     # -- device-loss recovery (docs/design.md §18) -------------------------

@@ -23,7 +23,7 @@ import numpy as np
 CONSUMES = {
     "serve.request": ("status", "reason", "tier", "mode",
                       "queue_wait_ms", "solve_ms",
-                      "approx", "err_bound"),
+                      "approx", "err_bound", "class"),
     "serve.batch": ("size", "solve_ms"),
     "serve.rollup": ("cache",),
     # the final registry snapshot (fia_tpu/obs): per-solver-rung and
@@ -40,6 +40,13 @@ CONSUMES = {
 # these lines across runs, and a row that appears only when nonzero
 # reads as "field renamed" rather than "count is zero".
 CANONICAL_REASONS = ("overload", "invalid", "deadline", "degraded")
+
+# The canonical priority classes (fia_tpu/serve/request.py), priority
+# order. Same convention as the reasons above: the per-class sections
+# always print all three, zeros included, so a silent class (quota'd
+# out, or simply absent from the traffic mix) shows as n=0 rather
+# than vanishing.
+CANONICAL_CLASSES = ("interactive", "batch", "scavenger")
 
 
 def pcts(vals):
@@ -118,6 +125,49 @@ def print_hist_section(title: str, snapshot: dict, prefix: str) -> None:
               f"p50={p50:.2f}ms  p99={p99:.2f}ms")
 
 
+def print_class_hist(title: str, snapshot: dict, prefix: str) -> None:
+    """p50/p99 per canonical class from the class-labelled registry
+    histograms — every class prints, n=0 rows included (a class the
+    quota or traffic mix silenced must read as zero, not vanish)."""
+    hists = snapshot.get("histograms", {})
+    buckets = snapshot.get("buckets_us", [])
+    print(title)
+    for cls in CANONICAL_CLASSES:
+        h = hists.get(f"{prefix}{{class={cls}}}")
+        if h is None:
+            print(f"  class={cls:<16} n=0")
+            continue
+        p50 = hist_pct(h, buckets, 50) / 1e3
+        p99 = hist_pct(h, buckets, 99) / 1e3
+        print(f"  class={cls:<16} n={int(h['count']):<6} "
+              f"p50={p50:.2f}ms  p99={p99:.2f}ms")
+
+
+def print_class_report(reqs: list) -> None:
+    """Per-class latency + rejection histograms from the request lines
+    (multi-tenant serving). Every canonical class prints, zeros
+    included; rejection rows follow the CANONICAL_REASONS convention."""
+    print("classes:")
+    for cls in CANONICAL_CLASSES:
+        rows = [r for r in reqs if r.get("class") == cls]
+        okc = [r for r in rows if r["status"] == "ok"]
+        rej = [r for r in rows if r["status"] != "ok"]
+        print(f"  {cls}: n={len(rows)}  ok={len(okc)}  "
+              f"rejected={len(rej)}")
+        if not rows:
+            continue
+        print(f"    queue wait: "
+              f"{pcts([r['queue_wait_ms'] for r in okc])}")
+        by_reason = {k: 0 for k in CANONICAL_REASONS}
+        for r in rej:
+            k = r.get("reason") or "<unreasoned!>"
+            by_reason[k] = by_reason.get(k, 0) + 1
+        for k in CANONICAL_REASONS:
+            print(f"    rejected[{k}]: {by_reason[k]}")
+        for k in sorted(set(by_reason) - set(CANONICAL_REASONS)):
+            print(f"    rejected[{k}]: {by_reason[k]}")
+
+
 def main(argv) -> int:
     if len(argv) != 2:
         print(__doc__.strip(), file=sys.stderr)
@@ -182,6 +232,12 @@ def main(argv) -> int:
     print(f"queue wait: {pcts([r['queue_wait_ms'] for r in ok])}")
     print(f"solve:      {pcts([r['solve_ms'] for r in ok])}")
 
+    # per-class lanes (multi-tenant serving): request lines carry a
+    # "class" field since the fair-queueing scheduler landed; old logs
+    # without it skip the section
+    if any(r.get("class") for r in reqs):
+        print_class_report(reqs)
+
     if batches:
         sizes = [b["size"] for b in batches]
         print(f"batches: {len(batches)}  "
@@ -202,6 +258,12 @@ def main(argv) -> int:
                            "serve.solve_by_mode_us")
         print_hist_section("queue wait by mode:", snapshot,
                            "serve.queue_wait_us")
+        if any(k.startswith("serve.queue_wait_by_class_us")
+               for k in snapshot.get("histograms", {})):
+            print_class_hist("queue wait by class:", snapshot,
+                             "serve.queue_wait_by_class_us")
+            print_class_hist("solve by class:", snapshot,
+                             "serve.solve_by_class_us")
     return 0
 
 

@@ -23,7 +23,36 @@ JAX_PLATFORMS=cpu timeout -k 10 300 python -m fia_tpu.cli.serve \
   --synth_train 2000 --synth_test 100 \
   --model MF --embed_size 4 --num_steps_train 300 \
   --train_dir "$DIR" --metrics "$DIR/serve.jsonl" \
-  --max_batch 16 --warmup 48 --smoke_requests 200
+  --max_batch 16 --warmup 48 --smoke_requests 200 \
+  --smoke_class_mix 'interactive=0.2,batch=0.5,scavenger=0.3'
+
+# Rollup accounting identity: the final serve.rollup line must
+# partition the stream exactly — requests == ok + Σ rejected[reason],
+# certified-approx answers a subset of ok, and every per-class lane
+# must balance the same way. A leak here means a response path forgot
+# to stamp its outcome (the in-process smoke can miss it because it
+# counts Response objects, not the emitted metrics).
+python - "$DIR/serve.jsonl" <<'EOF'
+import json, sys
+
+rollups = [json.loads(l) for l in open(sys.argv[1])
+           if '"serve.rollup"' in l]
+assert rollups, "no serve.rollup line in the metrics JSONL"
+r = rollups[-1]
+rejected = sum(r["rejected"].values())
+assert r["requests"] == r["ok"] + rejected, (
+    f"rollup accounting leak: {r['requests']} requests != "
+    f"{r['ok']} ok + {rejected} rejected")
+assert r["answered_approx"] <= r["ok"], (
+    f"approx answers ({r['answered_approx']}) exceed ok ({r['ok']})")
+for cls, lane in r.get("classes", {}).items():
+    lane_rej = sum(lane["rejected"].values())
+    assert lane["requests"] == lane["ok"] + lane_rej, (
+        f"class {cls!r} accounting leak: {lane}")
+print(f"rollup accounting ok: {r['requests']} requests == "
+      f"{r['ok']} ok + {rejected} rejected "
+      f"({len(r.get('classes', {}))} class lanes balanced)")
+EOF
 
 python scripts/latency_report.py "$DIR/serve.jsonl"
 echo "serve-smoke PASS"

@@ -81,10 +81,13 @@ bash scripts/obs_smoke.sh || {
 # exactly the ROADMAP.md pytest command, the smoke just surfaces
 # serving regressions in the same log.
 bash scripts/serve_smoke.sh || echo "serve-smoke FAILED (non-fatal here; run make serve-smoke)"
-# Scale smoke, NON-fatal for the same reason: row-sharded tables
+# Scale smoke, FATAL (green since PR 14): row-sharded tables
 # bit-identical to replicated at the 100k tier + per-device table
 # residency shrinking with model_parallel (docs/design.md §20).
-bash scripts/scale_smoke.sh || echo "scale-smoke FAILED (non-fatal here; run make scale-smoke)"
+bash scripts/scale_smoke.sh || {
+  echo "scale-smoke FAILED (run make scale-smoke)"
+  exit 1
+}
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
   -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
   -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log

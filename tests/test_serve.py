@@ -462,3 +462,313 @@ class TestSmoke:
         assert roll["ok"] + sum(roll["rejected"].values()) == 200
         assert roll["ok"] == 200  # no deadline/queue pressure here
         assert roll["solve_ms"]["p95"] >= roll["solve_ms"]["p50"] >= 0
+
+
+class TestMicroBatcherPins:
+    """Regression pins for the planner semantics the FairScheduler
+    wraps (the fair-queueing refactor must keep these green: its
+    single-class case returns MicroBatcher.plan verbatim)."""
+
+    def test_order_stable_under_equal_bucket_keys(self):
+        """Queries sharing a pad bucket keep ARRIVAL order: the bucket
+        sort is stable, so equal keys never reorder (the byte-identity
+        contract depends on this determinism)."""
+        from fia_tpu.serve import MicroBatcher
+
+        mb = MicroBatcher(max_batch=4, coalesce="bucket", pad_bucket=128)
+        # all counts land in the same 128-bucket -> order is arrival
+        counts = np.array([3, 120, 7, 64, 1])
+        assert np.array_equal(mb.order(counts), np.arange(5))
+        # two buckets: arrival order preserved WITHIN each bucket
+        counts = np.array([300, 3, 200, 7, 150])
+        order = list(mb.order(counts))
+        # buckets 384/128/256/128/256 -> 128s first (1,3 in arrival
+        # order), then 256s (2,4), then 384 (0)
+        assert order == [1, 3, 2, 4, 0]
+
+    def test_plan_ragged_final_chunk(self):
+        """7 queries at max_batch 3 -> chunks of 3/3/1: the ragged tail
+        dispatches as its own short batch, never merges or drops."""
+        from fia_tpu.serve import MicroBatcher
+
+        mb = MicroBatcher(max_batch=3, coalesce="fifo")
+        plan = mb.plan(np.full(7, 5))
+        assert [len(b) for b in plan] == [3, 3, 1]
+        assert np.array_equal(np.concatenate(plan), np.arange(7))
+
+    def test_fair_scheduler_single_class_verbatim(self):
+        """The pre-multi-tenant contract: with no class labels (or one
+        class), FairScheduler.plan IS MicroBatcher.plan, batch for
+        batch — legacy streams cannot observe the refactor."""
+        from fia_tpu.serve import FairScheduler, MicroBatcher
+
+        mb = MicroBatcher(max_batch=4, coalesce="bucket", pad_bucket=64)
+        fair = FairScheduler(mb)
+        rng = np.random.default_rng(5)
+        counts = rng.integers(1, 300, size=13)
+        want = mb.plan(counts)
+        for classes in (None, ["batch"] * 13, ["interactive"] * 13):
+            got = fair.plan(counts, classes)
+            assert len(got) == len(want)
+            for g, w in zip(got, want):
+                assert np.array_equal(g, w)
+
+
+class TestMultiTenant:
+    """Priority classes, quotas, weighted fair queueing and the
+    class-aware brownout ladder (docs/design.md §12)."""
+
+    def test_drr_plan_class_pure_and_priority_ordered(self):
+        """Mixed-class queues plan into class-pure batches, interactive
+        first, every position exactly once."""
+        from fia_tpu.serve import FairScheduler, MicroBatcher
+
+        fair = FairScheduler(MicroBatcher(max_batch=4, coalesce="fifo"))
+        counts = np.full(10, 3)
+        classes = (["scavenger"] * 5) + (["interactive"] * 5)
+        plan = fair.plan(counts, classes)
+        for b in plan:
+            labels = {classes[int(p)] for p in b}
+            assert len(labels) == 1, f"mixed batch {b}"
+        # interactive batches dispatch before any scavenger batch
+        first_cls = [classes[int(b[0])] for b in plan]
+        assert first_cls.index("scavenger") > max(
+            i for i, c in enumerate(first_cls) if c == "interactive")
+        covered = sorted(int(p) for b in plan for p in b)
+        assert covered == list(range(10))
+
+    def test_drr_scavenger_never_starves(self):
+        """Sustained interactive pressure across plan() calls: the
+        deficit counters still hand scavenger its batch each round
+        (weighted share, not absolute priority)."""
+        from fia_tpu.serve import FairScheduler, MicroBatcher
+
+        fair = FairScheduler(MicroBatcher(max_batch=2, coalesce="fifo"))
+        for _ in range(5):
+            counts = np.full(10, 2)
+            classes = (["interactive"] * 8) + (["scavenger"] * 2)
+            plan = fair.plan(counts, classes)
+            scav = [b for b in plan
+                    if classes[int(b[0])] == "scavenger"]
+            assert scav, "scavenger starved out of the plan"
+
+    def test_urgent_batches_promote_to_front(self):
+        """Deadline-aware packing: a batch holding an urgent position
+        stably moves to the plan front (multi-class plans only)."""
+        from fia_tpu.serve import FairScheduler, MicroBatcher
+
+        fair = FairScheduler(MicroBatcher(max_batch=2, coalesce="fifo"))
+        counts = np.full(6, 2)
+        classes = (["interactive"] * 4) + (["scavenger"] * 2)
+        urgent = [False] * 4 + [True, False]
+        plan = fair.plan(counts, classes, urgent)
+        assert classes[int(plan[0][0])] == "scavenger"  # promoted
+        assert 4 in {int(p) for p in plan[0]}
+
+    def test_scavenger_quota_flood_sheds_class_tagged(self):
+        """A scavenger flood past its queue quota sheds class-tagged
+        overload while interactive/batch headroom survives intact."""
+        model, params, train = _setup()
+        pts = _unique_points(train, 14)
+        eng = _engine(model, params, train)
+        svc = _service(eng, max_batch=4, max_queue=8,
+                       class_quotas={"scavenger": 0.5})
+        assert svc.admission.class_caps["scavenger"] == 4
+        rejected = []
+        for j, (u, i) in enumerate(pts[:8]):
+            r = svc.submit(Request(int(u), int(i), id=f"s{j}",
+                                   cls="scavenger", tenant="t-s"))
+            if r is not None:
+                rejected.append(r)
+        assert len(rejected) == 4
+        for r in rejected:
+            assert r.reason == "overload"
+            assert r.cls == "scavenger" and r.tenant == "t-s"
+            assert r.json()["class"] == "scavenger"
+        # the flood did not eat the other classes' headroom
+        for j, (u, i) in enumerate(pts[8:12]):
+            assert svc.submit(Request(int(u), int(i), id=f"i{j}",
+                                      cls="interactive")) is None
+        out = {r.id: r for r in svc.drain()}
+        assert all(out[f"i{j}"].ok for j in range(4))
+        roll = svc.rollup()
+        lane = roll["classes"]["scavenger"]
+        assert lane["requests"] == 8 and lane["ok"] == 4
+        assert lane["rejected"] == {"overload": 4}
+
+    def test_unknown_class_rejected_invalid(self):
+        model, params, train = _setup()
+        u, i = (int(v) for v in _unique_points(train, 1)[0])
+        eng = _engine(model, params, train)
+        svc = _service(eng)
+        r = svc.submit(Request(u, i, cls="platinum"))
+        assert r is not None and r.reason == "invalid"
+
+    def test_mixed_stream_class_pure_priority_dispatch(self):
+        """A mixed-class queue dispatches class-pure batches with
+        interactive batch ids strictly before scavenger batch ids."""
+        model, params, train = _setup()
+        pts = _unique_points(train, 12)
+        eng = _engine(model, params, train)
+        svc = _service(eng, max_batch=4)
+        reqs = []
+        for j, (u, i) in enumerate(pts):
+            cls = "scavenger" if j < 6 else "interactive"
+            reqs.append(Request(int(u), int(i), id=f"r{j}", cls=cls))
+        out = {r.id: r for r in svc.run(reqs)}
+        assert all(r.ok for r in out.values())
+        by_batch = {}
+        for j in range(12):
+            r = out[f"r{j}"]
+            by_batch.setdefault(r.batch_id, set()).add(r.cls)
+        assert all(len(c) == 1 for c in by_batch.values())
+        bid_of = {next(iter(c)): b for b, c in by_batch.items()}
+        i_bids = [b for b, c in by_batch.items() if "interactive" in c]
+        s_bids = [b for b, c in by_batch.items() if "scavenger" in c]
+        assert max(i_bids) < min(s_bids), (i_bids, s_bids)
+        assert bid_of  # appease linters: mapping exercised above
+
+    def test_mixed_stream_per_class_byte_identity(self):
+        """Each class lane of a mixed stream is bit-identical to the
+        same requests served as their own single-class stream — fair
+        interleaving reorders ACROSS lanes, never within one."""
+        model, params, train = _setup(seed=3)
+        pts = _unique_points(train, 12)
+        mixed_eng = _engine(model, params, train)
+        svc = _service(mixed_eng, max_batch=4)
+        reqs = []
+        for j, (u, i) in enumerate(pts):
+            cls = ("interactive", "batch", "scavenger")[j % 3]
+            reqs.append(Request(int(u), int(i), id=f"r{j}", cls=cls))
+        mixed = {r.id: r for r in svc.run(reqs)}
+        assert all(r.ok for r in mixed.values())
+        for cls in ("interactive", "batch", "scavenger"):
+            solo_eng = _engine(model, params, train)
+            solo_svc = _service(solo_eng, max_batch=4)
+            lane = [Request(r.user, r.item, id=r.id, cls=cls)
+                    for r in reqs if r.cls == cls]
+            solo = {r.id: r for r in solo_svc.run(lane)}
+            for rid, r in solo.items():
+                assert np.array_equal(mixed[rid].scores, r.scores)
+                assert np.array_equal(mixed[rid].ihvp, r.ihvp)
+
+    def _browned_service(self, eng, approx_ok=True):
+        from fia_tpu.serve import HealthConfig
+
+        svc = _service(
+            eng, max_batch=8,
+            health=HealthConfig(window=4, err_degrade=0.5,
+                                err_cache_only=2.0, err_recover=0.25,
+                                min_evidence=2, queue_hold=3, hold=8,
+                                approx_ok=approx_ok))
+        svc.health.observe(errors=8, dispatches=8, queue_depth=0,
+                           queue_cap=svc.admission.max_queue)
+        assert svc.health.mode == "bank_preferred"
+        return svc
+
+    def test_class_aware_brownout_interactive_stays_exact(self):
+        """At bank_preferred, interactive misses still solve EXACT
+        while batch/scavenger misses answer certified-approximate."""
+        model, params, train = _setup()
+        pts = _unique_points(train, 9)
+        eng = _engine(model, params, train)
+        svc = self._browned_service(eng)
+        reqs = []
+        for j, (u, i) in enumerate(pts):
+            cls = ("interactive", "batch", "scavenger")[j % 3]
+            reqs.append(Request(int(u), int(i), id=f"{cls[0]}{j}",
+                                cls=cls))
+        out = {r.id: r for r in svc.run(reqs)}
+        assert all(r.ok for r in out.values())
+        for rid, r in out.items():
+            if rid.startswith("i"):
+                assert not r.approx and r.err_bound is None
+            else:
+                assert r.approx and r.err_bound is not None
+        # exactness is byte-exact: the interactive answers match a
+        # healthy service's, bit for bit
+        healthy = _service(_engine(model, params, train), max_batch=8)
+        ref = {r.id: r for r in healthy.run(
+            [Request(q.user, q.item, id=q.id, cls=q.cls)
+             for q in reqs if q.cls == "interactive"])}
+        for rid, r in ref.items():
+            assert np.array_equal(out[rid].scores, r.scores)
+
+    def test_class_aware_brownout_approx_off_sheds_lower_classes(self):
+        """approx_ok=False: the lower classes shed ``degraded`` at
+        bank_preferred while interactive keeps solving exact."""
+        model, params, train = _setup()
+        pts = _unique_points(train, 6)
+        eng = _engine(model, params, train)
+        svc = self._browned_service(eng, approx_ok=False)
+        reqs = []
+        for j, (u, i) in enumerate(pts):
+            cls = ("interactive", "scavenger")[j % 2]
+            reqs.append(Request(int(u), int(i), id=f"{cls[0]}{j}",
+                                cls=cls))
+        out = {r.id: r for r in svc.run(reqs)}
+        for rid, r in out.items():
+            if rid.startswith("i"):
+                assert r.ok and not r.approx
+            else:
+                assert not r.ok and r.reason == "degraded"
+                assert r.cls == "scavenger"
+
+    def test_brownout_transitions_replay_deterministic(self):
+        """The same forced episode twice: the transition log replays
+        byte-identically (the PR 10 contract, kept class-aware)."""
+        model, params, train = _setup()
+        pts = _unique_points(train, 6)
+
+        def episode():
+            eng = _engine(model, params, train)
+            svc = self._browned_service(eng)
+            svc.run([Request(int(u), int(i), id=f"q{j}",
+                             cls=("interactive", "scavenger")[j % 2])
+                     for j, (u, i) in enumerate(pts)])
+            return svc.health.transitions
+
+        assert episode() == episode()
+
+    def test_rollup_class_lanes_partition_the_stream(self):
+        model, params, train = _setup()
+        pts = _unique_points(train, 10)
+        eng = _engine(model, params, train)
+        svc = _service(eng, max_batch=4, max_queue=4)
+        for j, (u, i) in enumerate(pts):
+            cls = ("interactive", "batch")[j % 2]
+            svc.submit(Request(int(u), int(i), id=f"r{j}", cls=cls))
+            if j % 4 == 3:
+                svc.drain()
+        svc.drain()
+        roll = svc.rollup()
+        lanes = roll["classes"]
+        assert sum(l["requests"] for l in lanes.values()) \
+            == roll["requests"]
+        for lane in lanes.values():
+            assert lane["ok"] + sum(lane["rejected"].values()) \
+                == lane["requests"]
+
+    def test_health_class_mode_ladder(self):
+        """The class-aware predicate table at each ladder rung."""
+        from fia_tpu.serve import HealthConfig
+        from fia_tpu.serve.health import HealthController
+
+        h = HealthController(HealthConfig())
+        assert h.class_mode("interactive") == "full"
+        assert h.allows_solve("scavenger")
+        h.mode = "bank_preferred"
+        assert h.class_mode("interactive") == "full"
+        assert h.class_mode("batch") == "bank_preferred"
+        assert h.allows_solve("interactive")
+        assert not h.allows_solve("scavenger")
+        assert h.allows_bank("batch")
+        assert not h.allows_bank("scavenger")  # loses bank a rung early
+        assert not h.allows_approx("interactive")  # exact-or-shed
+        assert h.allows_approx("scavenger")
+        h.mode = "cache_only"
+        for cls in ("interactive", "batch", "scavenger"):
+            assert h.class_mode(cls) == "cache_only"
+            assert not h.allows_solve(cls)
+            assert not h.allows_bank(cls)
+            assert not h.allows_approx(cls)
