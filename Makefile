@@ -1,7 +1,7 @@
 # Convenience targets. The commands themselves are pinned in
 # ROADMAP.md (tier-1) and scripts/ — these targets just name them.
 
-.PHONY: tier1 test lint lint-io serve-smoke serve-soak multichip-smoke factor-smoke chaos-smoke chaos-soak churn-smoke degraded-smoke approx-smoke kernel-smoke scale-smoke obs-smoke
+.PHONY: tier1 test lint lint-io serve-smoke serve-soak multichip-smoke factor-smoke chaos-smoke chaos-soak churn-smoke unlearn-smoke degraded-smoke approx-smoke kernel-smoke scale-smoke obs-smoke
 
 # The ROADMAP.md tier-1 verify: fast CPU suite, slow tests excluded.
 # Lint is fatal — a finding fails the build before pytest runs.
@@ -56,6 +56,12 @@ chaos-smoke:
 # bounded epoch-fence staleness window (docs/design.md §17).
 churn-smoke:
 	bash scripts/churn_smoke.sh
+
+# Unlearn smoke: the audit subsystem end to end on CPU (<60s) —
+# reverse sweep -> removal plan -> retraining verification -> fenced
+# live apply, with checksummed artifacts (docs/design.md §23)
+unlearn-smoke:
+	bash scripts/unlearn_smoke.sh
 
 # Kernel smoke: fused score-kernel parity on CPU (<60s) — Pallas
 # (interpret) + XLA analytic twin vs the vmapped-autodiff reference on

@@ -1972,6 +1972,154 @@ def scale_sweep_main():
     _maybe_json_out(out)
 
 
+def unlearn_main():
+    """``python bench.py unlearn [--quick] [--tiers 1m,10m]
+    [--json_out PATH]`` — the audit/unlearning subsystem at scale
+    (docs/design.md §23).
+
+    Per tier, three numbers the deletion story rides on:
+
+    - **rows audited/s**: the reverse top-k sweep
+      (:func:`fia_tpu.audit.reverse.reverse_topk`) streaming every
+      (test point, related row) pair through the fused ``query_many``
+      path and folding into the group accumulator;
+    - **end-to-end deletion latency**: build an
+      :class:`UnlearnPlan` from the sweep and flow it through the live
+      epoch-fenced apply under an attached service — seconds from
+      ``apply_plan`` entry to the committed swap, plus the staleness
+      window (params-ready → swap-complete);
+    - **zero-stale verification**: after the apply, touched AND
+      untouched probe responses are compared byte-for-byte against a
+      fresh compute on the live engine (``stale_hits`` must be 0 — the
+      churn bench's probe, pointed at the unlearning path).
+
+    No training (scale_sweep's argument): sweep throughput, fence
+    latency and staleness are properties of the serving/update hot
+    path, not of model quality — the fidelity of the *predictions* is
+    gated separately (``output/unlearn_gate_r18.npz``).
+    """
+    _ensure_live_backend()
+    import tempfile
+
+    import jax
+
+    from fia_tpu.api import FIAModel
+    from fia_tpu.audit import apply_plan, build_plan
+    from fia_tpu.audit.reverse import reverse_topk
+    from fia_tpu.data.dataset import RatingDataset
+    from fia_tpu.data.synthetic import SCALE_TIERS, synthesize_scale
+    from fia_tpu.serve import InfluenceService, Request, ServeConfig
+
+    k, wd, damping = 8, 1e-3, 1e-6
+    nq = 8 if QUICK else 32
+    plan_rows = 4 if QUICK else 16
+    upd_steps = 10 if QUICK else 40
+    tiers = ("1m",) if QUICK else ("1m", "10m")
+    if "--tiers" in sys.argv:
+        tiers = tuple(sys.argv[sys.argv.index("--tiers") + 1].split(","))
+    _stage(f"unlearn bench: backend={jax.default_backend()} "
+           f"tiers={','.join(tiers)}")
+
+    tier_out = {}
+    for tier in tiers:
+        users, items, rows = SCALE_TIERS[tier]
+        train = synthesize_scale(users, items, rows, seed=0)
+        workdir = tempfile.mkdtemp(prefix=f"fia-unlearn-{tier}-")
+        fm = FIAModel(
+            "MF", users, items, k, wd, batch_size=4096,
+            data_sets={"train": RatingDataset(train.x, train.y)},
+            initial_learning_rate=1e-2, damping=damping,
+            train_dir=workdir, model_name=f"bench-unlearn-{tier}",
+            solver="direct", seed=0,
+        )
+        rng = np.random.default_rng(7)
+        pts = train.x[
+            rng.choice(len(train.x), size=nq, replace=False)
+        ].astype(np.int64)
+        ty = np.full(len(pts), 3.0, np.float32)
+
+        _stage(f"tier {tier}: reverse sweep over {nq} test points, "
+               f"{rows} train rows")
+        sweep = reverse_topk(fm, pts, ty, k=plan_rows * 4,
+                             batch_queries=min(nq, 256))
+        _stage(f"tier {tier}: {sweep.rows_scored} row-scores in "
+               f"{sweep.seconds:.2f}s ({sweep.rows_per_s:,.0f} rows/s)")
+
+        plan = build_plan(fm, sweep, action="remove", max_rows=plan_rows)
+        svc = InfluenceService.from_model(
+            fm, config=ServeConfig(max_batch=32, disk_cache=False))
+
+        # probe pairs: inside the plan's footprint (must recompute) and
+        # outside it (re-keyed, bit-identical under projection)
+        removed = set(map(int, plan.row_ids))
+        tx = np.asarray(fm.data_sets["train"].x)
+        touched_u = {int(tx[j, 0]) for j in removed}
+        touched_i = {int(tx[j, 1]) for j in removed}
+        touched = [tuple(map(int, tx[j])) for j in sorted(removed)][:4]
+        untouched = []
+        for u, i in map(tuple, tx[rng.choice(len(tx), 64, replace=False)]):
+            if int(u) not in touched_u and int(i) not in touched_i:
+                untouched.append((int(u), int(i)))
+            if len(untouched) >= 4:
+                break
+        probes = touched + untouched
+        for pair in probes:  # warm the hot tier pre-apply
+            svc.run([Request(*pair)], drain_every=1)
+
+        _stage(f"tier {tier}: applying {plan.rows}-row removal plan "
+               f"live ({upd_steps} fine-tune steps)")
+        res = apply_plan(fm, plan, steps=upd_steps,
+                         checkpoint_every=upd_steps)
+        assert res.committed, res.reason
+
+        def fresh_bytes(pair):
+            probe = InfluenceService.from_model(
+                fm, config=ServeConfig(disk_cache=False))
+            return np.asarray(
+                probe.run([Request(*pair)])[0].scores).tobytes()
+
+        stale = 0
+        for pair in probes:
+            r = svc.run([Request(*pair)], drain_every=1)[0]
+            stale += (np.asarray(r.scores).tobytes() != fresh_bytes(pair))
+
+        tier_out[tier] = {
+            "num_users": users, "num_items": items, "num_rows": rows,
+            "audited_points": nq,
+            "rows_audited": int(sweep.rows_scored),
+            "sweep_seconds": round(sweep.seconds, 3),
+            "rows_audited_per_sec": round(sweep.rows_per_s, 1),
+            "plan_rows": int(plan.rows),
+            "predicted_delta": round(float(plan.predicted_delta), 6),
+            "deletion_latency_s": round(res.seconds, 3),
+            "staleness_window_ms": round(res.staleness_s * 1e3, 3),
+            "touched_users": res.touched_users,
+            "touched_items": res.touched_items,
+            "probes": len(probes),
+            "stale_hits": stale,
+        }
+        _stage(f"tier {tier}: deletion latency {res.seconds:.2f}s, "
+               f"staleness window {res.staleness_s * 1e3:.1f}ms, "
+               f"stale_hits={stale}")
+        assert stale == 0, f"served stale bytes after unlearning ({tier})"
+        del svc, fm, train
+
+    best = max(tier_out.values(), key=lambda t: t["rows_audited_per_sec"])
+    out = {
+        "metric": "fia-audit reverse sweep throughput (largest tier)",
+        "value": tier_out[tiers[-1]]["rows_audited_per_sec"],
+        "unit": "rows/sec",
+        "details": {
+            "backend": jax.default_backend(),
+            "device_count": jax.device_count(),
+            "best_rows_per_sec": best["rows_audited_per_sec"],
+            "tiers": tier_out,
+        },
+    }
+    print(json.dumps(out))
+    _maybe_json_out(out)
+
+
 def _lint_preflight() -> None:
     """``--lint``: fail fast on lint findings before burning device time.
 
@@ -2012,5 +2160,7 @@ if __name__ == "__main__":
         multichip_main()
     elif "scale_sweep" in sys.argv[1:]:
         scale_sweep_main()
+    elif "unlearn" in sys.argv[1:]:
+        unlearn_main()
     else:
         main()

@@ -180,6 +180,8 @@ class FIAModel:
         recorder = {
             "stream.update": "record_update",
             "factor.refresh": "record_factor_refresh",
+            "audit.sweep": "record_audit_sweep",
+            "audit.apply": "record_audit_apply",
         }.get(event)
         sent = False
         for svc in list(self._serving):
@@ -434,6 +436,23 @@ class FIAModel:
         from fia_tpu.stream.update import apply_updates as _apply
 
         return _apply(self, new_interactions, new_y=new_y, steps=steps,
+                      checkpoint_every=checkpoint_every)
+
+    def apply_removal(self, row_ids, steps: int = 100, reweight=None,
+                      checkpoint_every: int | None = None):
+        """Live unlearning: drop (or soften) train rows, fine-tune, swap.
+
+        The removal counterpart of :meth:`apply_updates` (same
+        epoch-fenced loop, same crash-safety and rollback): ``row_ids``
+        index the CURRENT train set; with ``reweight=w`` in [0, 1) the
+        rows stay but their labels soften to ``w·y + (1-w)·ŷ`` instead
+        of being deleted. Typically reached through an audited
+        :func:`fia_tpu.audit.plan.apply_plan` rather than called raw.
+        Returns a :class:`fia_tpu.stream.update.UpdateResult`.
+        """
+        from fia_tpu.stream.update import apply_removal as _apply
+
+        return _apply(self, row_ids, steps=steps, reweight=reweight,
                       checkpoint_every=checkpoint_every)
 
     # -- dataset mutation (genericNeuralNet.py:870-891) ---------------------

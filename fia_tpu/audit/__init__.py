@@ -1,0 +1,32 @@
+"""Influence-driven unlearning & data debugging (docs/design.md §23).
+
+The influence engine answers "how would removing train row j change
+this prediction?"; this package closes the loop and *acts* on the
+answer — GDPR-style deletion audits and label-noise triage as a
+product feature:
+
+- :mod:`fia_tpu.audit.reverse` — the batched **reverse top-k sweep**:
+  which training interactions most influence a whole test set,
+  streamed through the fused mega-batch dispatch path with a
+  deterministic device-side segmented top-k.
+- :mod:`fia_tpu.audit.plan` — turn the most-harmful rows into a
+  removal/reweighting :class:`UnlearnPlan` with a predicted test-loss
+  delta, and flow it live through the epoch-fenced streaming loop
+  (``stream.apply_removal``) under serve traffic.
+- :mod:`fia_tpu.audit.verify` — check predicted deltas against real
+  leave-one-out retraining on a small slice (sign agreement +
+  Spearman fidelity gate), journaled and resumable.
+
+Driver: ``python -m fia_tpu.cli.debug_data``; scale numbers:
+``python bench.py unlearn``.
+"""
+
+from fia_tpu.audit.plan import (  # noqa: F401
+    UnlearnPlan,
+    apply_plan,
+    build_plan,
+    load_plan,
+    save_plan,
+)
+from fia_tpu.audit.reverse import SweepResult, reverse_topk  # noqa: F401
+from fia_tpu.audit.verify import VerifyResult, verify_plan  # noqa: F401

@@ -1108,6 +1108,264 @@ class UpdateWhileServingScenario(Scenario):
         return failures
 
 
+class UnlearnWhileServingScenario(Scenario):
+    """An audited unlearning plan (``audit.plan.apply_plan``) flowing
+    through the live epoch-fenced loop under serve traffic, mid-apply
+    kills, and swap faults — docs/design.md §23.
+
+    Structurally the unlearning twin of ``update_while_serving``: same
+    two-community train set, but the deltas are REMOVALS chosen by a
+    real reverse sweep (``audit.reverse.reverse_topk`` over community-A
+    test points → ``build_plan``), not hand-picked appends. Apply 1 is
+    a ``remove`` plan, apply 2 a ``reweight`` plan built against the
+    shrunk post-removal set — exercising the stale-plan row-count gate
+    on the retry path too (a rollback must restore the train set or the
+    retry is refused as stale). Sweeping A-community test points keeps
+    every plan row inside A by construction (a B row shares no user or
+    item with an A test pair, so its sweep score is exactly zero and
+    the ``only_negative`` filter drops it); construction asserts this,
+    so community-B probes are provably outside both footprints' READ
+    reach (stream/footprint.py). Oracles as in the update twin:
+
+    - every probe byte-matches the reference of its admission state;
+    - community-B probes are bit-identical in every wave;
+    - a rolled-back apply keeps serving the old state; the retry
+      (resuming the attempt's checkpoints) commits the golden bytes;
+    - committed swaps re-key untouched entries, never wholesale-flush;
+    - plan identity is deterministic: plan ids and predicted deltas
+      must replay exactly against the golden run (``plan_determinism``).
+    """
+
+    name = "unlearn_while_serving"
+    BASE_STEPS, STEPS, EVERY = 24, 16, 4
+    # sweep provenance: test points inside community A (users 0-14 x
+    # items 0-9); B probes below are untouched by construction
+    TESTPTS = ((2, 3), (5, 1), (11, 8), (7, 2), (13, 6), (4, 4))
+    TOUCHED = ((2, 3), (5, 1), (11, 8))
+    UNTOUCHED = ((16, 12), (22, 17), (28, 11))
+    FENCE = (2, 3)
+    PLAN_ROWS = 3
+    # each apply fires audit.apply once and stream.swap once on a
+    # fault-free attempt: 2 guaranteed calls per site across the two
+    # plans; the retry budget absorbs a worst-case 3-fault schedule
+    benign_domain = {
+        sites.AUDIT_APPLY: (_TRANSIENT_KINDS, 2),
+        sites.STREAM_SWAP: (_TRANSIENT_KINDS, 2),
+    }
+    full_domain = {
+        sites.AUDIT_APPLY: (_TRANSIENT_KINDS + _KILL_KINDS, 2),
+        sites.STREAM_SWAP: (_TRANSIENT_KINDS + _KILL_KINDS, 2),
+        sites.CHAOS_SCENARIO: ((taxonomy.WORKER,), 1),
+    }
+
+    def __init__(self):
+        import tempfile
+
+        from fia_tpu.api import FIAModel
+        from fia_tpu.audit.plan import build_plan
+        from fia_tpu.audit.reverse import reverse_topk
+        from fia_tpu.data.dataset import RatingDataset
+
+        x, y = UpdateWhileServingScenario._community_data(1, 240)
+        self.fm = FIAModel(
+            "MF", _U, _I, _K, _WD, batch_size=50,
+            data_sets={"train": RatingDataset(x, y)},
+            initial_learning_rate=1e-2, damping=_DAMP,
+            train_dir=tempfile.mkdtemp(prefix="fia-chaos-unlearn-init-"),
+            model_name="chaos-unlearn", solver="direct", seed=0,
+        )
+        self.fm._trainer.clock = rpolicy.VirtualClock()
+        self.fm.train(self.BASE_STEPS, save_checkpoints=False,
+                      verbose=False)
+        self.base_state = self.fm.state
+        self.base_train = self.fm.data_sets["train"]
+
+        pts = np.asarray(self.TESTPTS, np.int64)
+        ty = np.asarray(self.base_train.y[:len(pts)], np.float32)
+
+        def _plan(action):
+            sweep = reverse_topk(self.fm, pts, ty, k=16)
+            return build_plan(self.fm, sweep, action=action,
+                              max_rows=self.PLAN_ROWS)
+
+        # fault-free golden pass: plans + per-state references, each
+        # probe served alone (T=1) so bytes are batch-independent
+        self.ref_old = self._snapshot_refs()
+        self.plan1 = _plan("remove")
+        rx = np.asarray(self.base_train.x, np.int64)[self.plan1.row_ids]
+        assert bool(np.all(rx[:, 0] < 15) and np.all(rx[:, 1] < 10)), (
+            "sweep surfaced a community-B row for A-only test points")
+        assert self._apply_plan(self.plan1).committed
+        self.ref_mid = self._snapshot_refs()
+        # the reweight plan is built against the SHRUNK train set — its
+        # row-count stamp is what the stale-plan gate checks on retry
+        self.plan2 = _plan("reweight")
+        assert self._apply_plan(self.plan2).committed
+        self.ref_new = self._snapshot_refs()
+        self._reset()
+        for p in self.UNTOUCHED:
+            # both footprints' READ reach stops at the community border
+            assert self.ref_old[p] == self.ref_mid[p] == self.ref_new[p], (
+                f"untouched probe {p} moved across an unlearning apply")
+        assert self.ref_old[self.FENCE] != self.ref_mid[self.FENCE]
+        assert self.ref_mid[self.FENCE] != self.ref_new[self.FENCE]
+
+    def _apply_plan(self, plan):
+        from fia_tpu.audit.plan import apply_plan
+
+        return apply_plan(self.fm, plan, steps=self.STEPS,
+                          checkpoint_every=self.EVERY)
+
+    def _reset(self):
+        self.fm.state = self.base_state
+        self.fm.data_sets["train"] = self.base_train
+        self.fm._engines.clear()
+
+    def _service(self):
+        from fia_tpu.serve.service import InfluenceService, ServeConfig
+
+        return InfluenceService.from_model(
+            self.fm, config=ServeConfig(), clock=rpolicy.VirtualClock())
+
+    def _one(self, svc, pair, rid):
+        from fia_tpu.serve.request import Request
+
+        return svc.run([Request(pair[0], pair[1], id=rid)],
+                       drain_every=1)[0]
+
+    def _snapshot_refs(self) -> dict:
+        svc = self._service()
+        return {
+            p: np.asarray(self._one(svc, p, f"ref{k}").scores).tobytes()
+            for k, p in enumerate(self.TOUCHED + self.UNTOUCHED)
+        }
+
+    def _wave(self, svc, wave: str, refs: dict, out: dict,
+              events: list) -> None:
+        for k, p in enumerate(self.TOUCHED + self.UNTOUCHED):
+            r = self._one(svc, p, f"{wave}{k}")
+            match = bool(r.ok) and (
+                np.asarray(r.scores).tobytes() == refs[p])
+            events.append({"event": "probe_served", "wave": wave,
+                           "probe": k, "match": match})
+            if r.ok:
+                out[f"{wave}{k}:scores"] = np.asarray(r.scores).copy()
+
+    def _apply(self, svc, plan, events: list, tag: int,
+               probe_on_rollback: bool):
+        """One plan apply under the chaos retry budget; a rolled-back
+        attempt restores the pre-apply train set (else the retry would
+        be refused as a stale plan) and leaves its checkpoints behind,
+        so the retry resumes rather than restarts."""
+        for attempt in range(_CHAOS_RETRY.max_attempts):
+            r = self._apply_plan(plan)
+            if r.committed:
+                if attempt:
+                    events.append({"event": "apply_retried",
+                                   "plan": plan.plan_id,
+                                   "attempts": attempt + 1})
+                return r
+            events.append({"event": "apply_rolled_back", "apply": tag,
+                           "plan": plan.plan_id, "reason": r.reason,
+                           "resumed_step": r.resumed_step})
+            if probe_on_rollback:
+                pr = self._one(svc, self.FENCE, f"rb{tag}-{attempt}")
+                events.append({
+                    "event": "post_rollback_serve", "update": tag,
+                    "ok": bool(pr.ok) and (
+                        np.asarray(pr.scores).tobytes()
+                        == self.ref_old[self.FENCE]),
+                })
+        raise taxonomy.DeadlineExpired(
+            f"plan {plan.plan_id} never committed within the retry budget")
+
+    def run(self, workdir: str, events: list) -> dict:
+        from fia_tpu.serve.request import Request
+
+        self._reset()
+        self.fm.train_dir = os.path.join(workdir, "train")
+        svc = self._service()
+        out: dict = {}
+
+        self._wave(svc, "pre", self.ref_old, out, events)
+        r1 = self._apply(svc, self.plan1, events, 1,
+                         probe_on_rollback=True)
+        self._wave(svc, "mid", self.ref_mid, out, events)
+
+        # epoch fence: admitted before the reweight apply, drained
+        # after — must answer on its admission state (post-removal)
+        assert svc.submit(Request(*self.FENCE, id="fence")) is None
+        r2 = self._apply(svc, self.plan2, events, 2,
+                         probe_on_rollback=False)
+        fr = next(r for r in svc.drain() if r.id == "fence")
+        events.append({"event": "probe_served", "wave": "fence",
+                       "probe": 0,
+                       "match": bool(fr.ok) and (
+                           np.asarray(fr.scores).tobytes()
+                           == self.ref_mid[self.FENCE])})
+        if fr.ok:
+            out["fence:scores"] = np.asarray(fr.scores).copy()
+        self._wave(svc, "post", self.ref_new, out, events)
+
+        st = svc.cache.stats
+        events.append({"event": "swap_stats",
+                       "rekeyed": int(st.rekeyed),
+                       "rekey_dropped": int(st.rekey_dropped),
+                       "disk_rekeyed": int(st.disk_rekeyed),
+                       "disk_rekey_dropped": int(st.disk_rekey_dropped)})
+        out["apply1"] = r1.status
+        out["apply2"] = r2.status
+        out["plan1"] = self.plan1.plan_id
+        out["plan2"] = self.plan2.plan_id
+        out["predicted_delta1"] = round(self.plan1.predicted_delta, 6)
+        out["predicted_delta2"] = round(self.plan2.predicted_delta, 6)
+        out["train_rows"] = len(self.fm.data_sets["train"].x)
+        out["epochs"] = int(svc.epoch)
+        return out
+
+    def check(self, golden: dict, record) -> list:
+        from fia_tpu.chaos.oracles import OracleFailure
+
+        if record.error is not None or record.outcome is None:
+            return []
+        failures = []
+        for e in record.events:
+            if e.get("event") == "probe_served" and not e["match"]:
+                failures.append(OracleFailure(
+                    "epoch_serving_integrity",
+                    f"wave {e['wave']} probe {e['probe']}: served bytes "
+                    "do not match the reference of the state the request "
+                    "was admitted under (stale or half-swapped answer)",
+                ))
+            elif e.get("event") == "post_rollback_serve" and not e["ok"]:
+                failures.append(OracleFailure(
+                    "rollback_keeps_serving",
+                    f"after a rolled-back apply {e['update']}, serving "
+                    "did not answer bit-identically on the old state",
+                ))
+        for key in ("plan1", "plan2", "predicted_delta1",
+                    "predicted_delta2", "train_rows"):
+            if record.outcome.get(key) != golden.get(key):
+                failures.append(OracleFailure(
+                    "plan_determinism",
+                    f"{key} diverged from the golden run: "
+                    f"{record.outcome.get(key)!r} != {golden.get(key)!r} "
+                    "— plan identity must be a pure function of the "
+                    "sweep, not of the fault schedule",
+                ))
+        stats = next((e for e in record.events
+                      if e.get("event") == "swap_stats"), None)
+        if stats is not None and (
+                stats["rekeyed"] + stats["disk_rekeyed"]) == 0:
+            failures.append(OracleFailure(
+                "surgical_invalidation",
+                "no cache entry survived the swaps by re-keying — the "
+                "untouched community-B blocks must ride through a "
+                "footprinted unlearning apply without recompute",
+            ))
+        return failures
+
+
 class ServeBrownoutScenario(Scenario):
     """Certified-approximate serving through a forced brownout episode
     (docs/design.md §22, docs/reliability.md "Degraded modes").
@@ -1674,6 +1932,7 @@ def make_scenarios() -> dict:
         DeviceLossRecoveryScenario.name: DeviceLossRecoveryScenario,
         FactorBankScenario.name: FactorBankScenario,
         UpdateWhileServingScenario.name: UpdateWhileServingScenario,
+        UnlearnWhileServingScenario.name: UnlearnWhileServingScenario,
         ServeBrownoutScenario.name: ServeBrownoutScenario,
         ServeMultitenantScenario.name: ServeMultitenantScenario,
     }

@@ -40,8 +40,8 @@ from fia_tpu.chaos.scenarios import SCENARIO_NAMES
 SMOKE_SCENARIOS = ("selftest", "train_resume", "query_cache",
                    "serve_stream", "serve_stream_mesh",
                    "device_loss_recovery", "factor_bank",
-                   "update_while_serving", "serve_brownout",
-                   "serve_multitenant")
+                   "update_while_serving", "unlearn_while_serving",
+                   "serve_brownout", "serve_multitenant")
 SMOKE_SEEDS_PER_SCENARIO = 2
 SMOKE_FAULTS = 3
 

@@ -64,6 +64,10 @@ MESH_REBUILD = "mesh.rebuild"
 STREAM_UPDATE = "stream.update"
 STREAM_SWAP = "stream.swap"
 
+# -- audit / unlearning (docs/design.md §23) ---------------------------
+AUDIT_SWEEP = "audit.sweep"
+AUDIT_APPLY = "audit.apply"
+
 # -- chaos scenario engine ---------------------------------------------
 CHAOS_SCENARIO = "chaos.scenario"
 CHAOS_UNIT = "chaos.unit"
@@ -88,6 +92,8 @@ ALL_SITES = frozenset({
     MESH_REBUILD,
     STREAM_UPDATE,
     STREAM_SWAP,
+    AUDIT_SWEEP,
+    AUDIT_APPLY,
     CHAOS_SCENARIO,
     CHAOS_UNIT,
 })

@@ -59,13 +59,21 @@ class AdmissionController:
     ``DEFAULT_CLASS_QUOTAS`` — each class's waiting tickets are bounded
     by ``max(1, round(frac * max_queue))`` so a lower-priority flood
     fills only its own lane.
+    ``tenant_quotas``: the same bound one level down — fractions keyed
+    by tenant label, applied UNDER the class quotas (both must pass).
+    Only listed tenants are capped; unlisted tenants (and unlabelled
+    requests) see no per-tenant bound, so the knob is opt-in per
+    tenant exactly like ``class_quotas`` is per class. One noisy
+    tenant inside a class can otherwise starve its own class's lane —
+    the class quota is blind to who filled it.
     """
 
     def __init__(self, max_queue: int = 256,
                  default_deadline_s: float | None = None,
                  num_users: int | None = None,
                  num_items: int | None = None,
-                 class_quotas: dict[str, float] | None = None):
+                 class_quotas: dict[str, float] | None = None,
+                 tenant_quotas: dict[str, float] | None = None):
         self.max_queue = max(int(max_queue), 1)
         self.default_deadline_s = default_deadline_s
         self.num_users = num_users
@@ -84,13 +92,24 @@ class AdmissionController:
             cls: max(1, int(round(float(frac) * self.max_queue)))
             for cls, frac in quotas.items()
         }
+        for tenant, frac in (tenant_quotas or {}).items():
+            if not 0.0 < float(frac) <= 1.0:
+                raise ValueError(
+                    f"tenant quota for {tenant!r} must be in (0, 1], "
+                    f"got {frac}")
+        self.tenant_caps = {
+            tenant: max(1, int(round(float(frac) * self.max_queue)))
+            for tenant, frac in (tenant_quotas or {}).items()
+        }
 
     def reject_reason(self, req: Request, queue_depth: int,
-                      class_depth: int = 0) -> str | None:
+                      class_depth: int = 0,
+                      tenant_depth: int = 0) -> str | None:
         """The rejection reason for ``req`` at ``queue_depth``, or None
         when it is admitted. ``class_depth`` is the count of queued
-        tickets already in ``req``'s class (0 keeps the single-tenant
-        behaviour: only the total bound applies)."""
+        tickets already in ``req``'s class, ``tenant_depth`` the count
+        already carrying ``req``'s tenant label (0 keeps the
+        single-tenant behaviour: only the total bound applies)."""
         u, i = int(req.user), int(req.item)
         if u < 0 or i < 0:
             return REASON_INVALID
@@ -103,6 +122,10 @@ class AdmissionController:
         if queue_depth >= self.max_queue:
             return REASON_OVERLOAD
         if class_depth >= self.class_caps[req.cls]:
+            return REASON_OVERLOAD
+        cap = (self.tenant_caps.get(req.tenant)
+               if req.tenant is not None else None)
+        if cap is not None and tenant_depth >= cap:
             return REASON_OVERLOAD
         return None
 

@@ -96,6 +96,19 @@ SCHEMA = {
     ),
     # surgical factor-bank refresh on a params/train change
     "factor.refresh": ("kept", "dropped", "model_key"),
+    # audit subsystem (docs/design.md §23): one line per reverse
+    # top-k sweep over the training stream ...
+    "audit.sweep": (
+        "sweep_id", "test_points", "train_rows", "rows_scored",
+        "chunks", "k", "seconds", "rows_per_s",
+    ),
+    # ... and one per live unlearning apply (removal/reweight flowed
+    # through the epoch-fenced stream loop)
+    "audit.apply": (
+        "plan_id", "action", "status", "reason", "rows_removed",
+        "rows_reweighted", "predicted_delta", "steps",
+        "touched_users", "touched_items", "seconds",
+    ),
 }
 
 
@@ -241,6 +254,14 @@ class ServeMetrics:
     def record_factor_refresh(self, **fields) -> None:
         """One ``factor.refresh`` line (surgical bank revalidation)."""
         self.log.log("factor.refresh", **fields)
+
+    def record_audit_sweep(self, **fields) -> None:
+        """One ``audit.sweep`` line (a reverse top-k sweep)."""
+        self.log.log("audit.sweep", **fields)
+
+    def record_audit_apply(self, **fields) -> None:
+        """One ``audit.apply`` line (a live unlearning apply)."""
+        self.log.log("audit.apply", **fields)
 
     def rollup(self, cache_stats: dict | None = None) -> dict:
         n = sum(self.by_status.values())

@@ -119,6 +119,11 @@ class ServeConfig:
     # streams behave exactly as before the multi-tenant layer).
     class_quotas: dict | None = None
     class_weights: dict | None = None
+    # Per-tenant admission quotas (fractions of max_queue), applied
+    # UNDER the class quotas: {"acme": 0.25} bounds tenant "acme" to a
+    # quarter of the queue regardless of class mix. Unlisted tenants
+    # and unlabelled requests are uncapped (opt-in per tenant).
+    tenant_quotas: dict | None = None
     # Deadline-aware packing: a queued request whose remaining budget
     # is at or under this slack promotes its batch to the front of a
     # multi-class plan. None disables the promotion (single-class
@@ -224,11 +229,13 @@ class InfluenceService:
             num_users=eng.model.num_users,
             num_items=eng.model.num_items,
             class_quotas=self.config.class_quotas,
+            tenant_quotas=self.config.tenant_quotas,
         )
         self._queue: list[Ticket] = []
-        # queued tickets per class (admission quota signal) — rebuilt
-        # to empty when a drain swaps the queue out
+        # queued tickets per class / per tenant (admission quota
+        # signals) — rebuilt to empty when a drain swaps the queue out
         self._class_depth: dict[str, int] = {}
+        self._tenant_depth: dict[str, int] = {}
         self._next_id = 0
         self._batch_id = 0
         self._fp_cache: tuple | None = None  # (engine identity, digest)
@@ -375,6 +382,8 @@ class InfluenceService:
         reason = self.admission.reject_reason(
             req, len(self._queue),
             class_depth=self._class_depth.get(req.cls, 0),
+            tenant_depth=(self._tenant_depth.get(req.tenant, 0)
+                          if req.tenant is not None else 0),
         )
         if reason is not None:
             resp = Response(
@@ -391,6 +400,9 @@ class InfluenceService:
         t.epoch = self._epoch
         self._queue.append(t)
         self._class_depth[req.cls] = self._class_depth.get(req.cls, 0) + 1
+        if req.tenant is not None:
+            self._tenant_depth[req.tenant] = (
+                self._tenant_depth.get(req.tenant, 0) + 1)
         return None
 
     @property
@@ -436,6 +448,7 @@ class InfluenceService:
         depth = len(self._queue)  # health signal: occupancy at drain start
         work, self._queue = self._queue, []
         self._class_depth = {}
+        self._tenant_depth = {}
         now = self.clock()
         # the mode is FIXED for the whole drain (self.health only moves
         # in the observe() below) — within-drain decisions stay a pure

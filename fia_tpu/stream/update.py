@@ -34,6 +34,12 @@ never stops and never answers from a half-swapped state. Unclassified
 failures surface. Fault sites: ``stream.update`` fires at the start of
 every attempt, ``stream.swap`` immediately before the commit touches
 any model state.
+
+``apply_removal`` is the unlearning twin (docs/design.md §23): the
+delta is rows *leaving* the train set (or having their labels softened
+toward the model's prediction), but steps 1-3 are byte-for-byte the
+same machinery — removed rows' users/items are the footprint, and the
+``audit.apply`` site replaces ``stream.update`` at attempt start.
 """
 
 from __future__ import annotations
@@ -154,31 +160,25 @@ def _coerce_rows(new_x, new_y):
     )
 
 
-def apply_updates(model, new_x, new_y=None, steps: int = 100,
+def _apply_fenced(model, prepare, *, steps: int, uid: str, fp_kind: str,
+                  entry_site: str, new_rows: int,
                   checkpoint_every: int | None = None,
                   keep_checkpoints: int = 3) -> UpdateResult:
-    """Run one streaming update against ``model`` (see module doc).
+    """The shared fine-tune → project → epoch-fenced-swap core.
 
-    ``checkpoint_every``: steps between rotated mid-update checkpoints
-    (default ``max(1, steps // 4)``; saves land at the trainer's
-    dispatch boundaries). Returns an :class:`UpdateResult`; a classified
-    failure rolls back and reports, an unclassified one raises.
+    ``prepare()`` runs after the entry fault site fires (so a site
+    fault rolls back before any work) and returns
+    ``(new_train, footprint, warm_x)``: the post-delta train set, the
+    invalidation footprint, and one (user, item) row to pre-warm the
+    new engine's dispatch with. Both write paths — append
+    (:func:`apply_updates`) and removal/reweight
+    (:func:`apply_removal`) — differ only in that closure.
     """
-    nx, ny = _coerce_rows(new_x, new_y)
-    if len(nx) == 0:
-        raise ValueError("apply_updates needs at least one new interaction")
-    if nx[:, 0].min() < 0 or nx[:, 0].max() >= model.model.num_users or \
-            nx[:, 1].min() < 0 or nx[:, 1].max() >= model.model.num_items:
-        raise ValueError(
-            "new interaction ids fall outside the model's user/item tables"
-        )
-
     clock = model._trainer.clock
     t0 = clock.monotonic()
     old_state = model.state
     old_train = model.data_sets["train"]
     base_step = int(old_state.step)
-    uid = _update_id(model, nx, ny, steps)
     ckpt_dir = (
         os.path.join(model.train_dir, "stream", f"upd-{uid}")
         if model.train_dir else None
@@ -189,18 +189,11 @@ def apply_updates(model, new_x, new_y=None, steps: int = 100,
     resumed_step = None
     footprint = None
     try:
-        inject.fire(sites.STREAM_UPDATE)
-        footprint = compute_footprint(
-            np.asarray(old_train.x), nx,
-            model.model.num_users, model.model.num_items,
-        )
-        new_train = RatingDataset(
-            np.concatenate([np.asarray(old_train.x, np.int32), nx]),
-            np.concatenate([np.asarray(old_train.y, np.float32), ny]),
-        )
+        inject.fire(entry_site)
+        new_train, footprint, warm_x = prepare()
 
         fp = {
-            "kind": "stream-update",
+            "kind": fp_kind,
             "model_key": model.model_name,
             "base_step": base_step,
             "steps": int(steps),
@@ -280,7 +273,7 @@ def apply_updates(model, new_x, new_y=None, steps: int = 100,
                 # trace/compile. A warmup failure means the new engine
                 # cannot serve, so it (rightly) flows to the classified
                 # rollback below.
-                svc.warmup(nx[:1])
+                svc.warmup(warm_x)
             for svc in services:
                 svc.advance_epoch(footprint)
         staleness_s = clock.monotonic() - t_ready
@@ -288,7 +281,7 @@ def apply_updates(model, new_x, new_y=None, steps: int = 100,
             shutil.rmtree(ckpt_dir, ignore_errors=True)
         result = UpdateResult(
             status="committed", update_id=uid, steps=int(steps),
-            new_rows=len(nx), base_step=base_step,
+            new_rows=new_rows, base_step=base_step,
             resumed_step=resumed_step,
             touched_users=footprint.num_touched_users,
             touched_items=footprint.num_touched_items,
@@ -309,7 +302,7 @@ def apply_updates(model, new_x, new_y=None, steps: int = 100,
             model._engines.clear()
         result = UpdateResult(
             status="rolled_back", update_id=uid, steps=int(steps),
-            new_rows=len(nx), reason=kind, base_step=base_step,
+            new_rows=new_rows, reason=kind, base_step=base_step,
             resumed_step=resumed_step,
             touched_users=(footprint.num_touched_users if footprint else 0),
             touched_items=(footprint.num_touched_items if footprint else 0),
@@ -319,6 +312,47 @@ def apply_updates(model, new_x, new_y=None, steps: int = 100,
     finally:
         cfg.iter_to_switch_to_batch = saved_switches[0]
         cfg.iter_to_switch_to_sgd = saved_switches[1]
+    return result
+
+
+def apply_updates(model, new_x, new_y=None, steps: int = 100,
+                  checkpoint_every: int | None = None,
+                  keep_checkpoints: int = 3) -> UpdateResult:
+    """Run one streaming update against ``model`` (see module doc).
+
+    ``checkpoint_every``: steps between rotated mid-update checkpoints
+    (default ``max(1, steps // 4)``; saves land at the trainer's
+    dispatch boundaries). Returns an :class:`UpdateResult`; a classified
+    failure rolls back and reports, an unclassified one raises.
+    """
+    nx, ny = _coerce_rows(new_x, new_y)
+    if len(nx) == 0:
+        raise ValueError("apply_updates needs at least one new interaction")
+    if nx[:, 0].min() < 0 or nx[:, 0].max() >= model.model.num_users or \
+            nx[:, 1].min() < 0 or nx[:, 1].max() >= model.model.num_items:
+        raise ValueError(
+            "new interaction ids fall outside the model's user/item tables"
+        )
+    old_train = model.data_sets["train"]
+    uid = _update_id(model, nx, ny, steps)
+
+    def prepare():
+        footprint = compute_footprint(
+            np.asarray(old_train.x), nx,
+            model.model.num_users, model.model.num_items,
+        )
+        new_train = RatingDataset(
+            np.concatenate([np.asarray(old_train.x, np.int32), nx]),
+            np.concatenate([np.asarray(old_train.y, np.float32), ny]),
+        )
+        return new_train, footprint, nx[:1]
+
+    result = _apply_fenced(
+        model, prepare, steps=steps, uid=uid, fp_kind="stream-update",
+        entry_site=sites.STREAM_UPDATE, new_rows=len(nx),
+        checkpoint_every=checkpoint_every,
+        keep_checkpoints=keep_checkpoints,
+    )
     model._log_event(
         "stream.update",
         update_id=result.update_id, status=result.status,
@@ -331,3 +365,81 @@ def apply_updates(model, new_x, new_y=None, steps: int = 100,
         seconds=round(result.seconds, 3),
     )
     return result
+
+
+def _removal_id(model, row_ids: np.ndarray, tag: str, steps: int) -> str:
+    """Deterministic id binding a removal/reweight to (base params, rows,
+    action, steps) — the resuming retry of a killed unlearning apply
+    agrees on the checkpoint directory and fingerprint."""
+    h = hashlib.sha1()
+    h.update(str(int(model.state.step)).encode())
+    for leaf in jax.tree_util.tree_leaves(model._host_params()):
+        h.update(np.ascontiguousarray(leaf).tobytes())
+    h.update(tag.encode())
+    h.update(np.ascontiguousarray(row_ids).tobytes())
+    h.update(str(int(steps)).encode())
+    return h.hexdigest()[:12]
+
+
+def apply_removal(model, row_ids, steps: int = 100,
+                  reweight: float | None = None,
+                  checkpoint_every: int | None = None,
+                  keep_checkpoints: int = 3) -> UpdateResult:
+    """Unlearn training rows through the same epoch-fenced loop.
+
+    ``row_ids``: indices into the current train set. ``reweight=None``
+    deletes the rows outright (the GDPR path); ``reweight=w`` with
+    ``0 <= w < 1`` keeps them but softens each label toward the model's
+    own prediction, ``y' = w*y + (1-w)*ŷ`` — at ``w=0`` the row carries
+    no residual signal, so the label-noise-triage path shades into
+    deletion continuously. Everything downstream is shared with
+    :func:`apply_updates`: the removed rows' users/items are the
+    footprint delta (second-order reach through the OLD adjacency,
+    exactly the read set the removed rows participated in), fine-tuning
+    runs on the shrunk set, untouched blocks are projected back to
+    their pre-update bytes, and the swap is epoch-fenced with surgical
+    invalidation and classified-failure rollback. Fault site:
+    ``audit.apply`` fires at the start of every attempt (the swap keeps
+    its own ``stream.swap`` site).
+    """
+    old_train = model.data_sets["train"]
+    rows = np.unique(np.asarray(row_ids, np.int64).reshape(-1))
+    if len(rows) == 0:
+        raise ValueError("apply_removal needs at least one row to unlearn")
+    if rows[0] < 0 or rows[-1] >= len(old_train.x):
+        raise ValueError(
+            "row ids fall outside the current train set "
+            f"(0..{len(old_train.x) - 1})"
+        )
+    if reweight is not None and not (0.0 <= float(reweight) < 1.0):
+        raise ValueError("reweight must be in [0, 1) — 1.0 is a no-op")
+    tag = "remove" if reweight is None else f"reweight:{float(reweight)!r}"
+    uid = _removal_id(model, rows, tag, steps)
+
+    def prepare():
+        old_x = np.asarray(old_train.x, np.int32)
+        old_y = np.asarray(old_train.y, np.float32)
+        removed_x = old_x[rows]
+        footprint = compute_footprint(
+            old_x, removed_x,
+            model.model.num_users, model.model.num_items,
+        )
+        if reweight is None:
+            keep = np.ones(len(old_x), bool)
+            keep[rows] = False
+            new_train = RatingDataset(old_x[keep], old_y[keep])
+        else:
+            w = np.float32(reweight)
+            preds = np.asarray(model.model.predict(
+                model.state.params, jnp.asarray(removed_x)), np.float32)
+            new_y = np.array(old_y)
+            new_y[rows] = w * old_y[rows] + (np.float32(1.0) - w) * preds
+            new_train = RatingDataset(old_x, new_y)
+        return new_train, footprint, removed_x[:1]
+
+    return _apply_fenced(
+        model, prepare, steps=steps, uid=uid, fp_kind="audit-apply",
+        entry_site=sites.AUDIT_APPLY, new_rows=len(rows),
+        checkpoint_every=checkpoint_every,
+        keep_checkpoints=keep_checkpoints,
+    )

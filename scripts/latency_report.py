@@ -33,6 +33,12 @@ CONSUMES = {
     # ``engine.sampled`` markers the certified sampled rung attaches
     # to its dispatch spans (queries / escalations / max bound)
     "obs.span": ("name", "events"),
+    # audit subsystem (fia_tpu/audit): one line per reverse top-k
+    # sweep and per live unlearning apply (docs/design.md §23)
+    "audit.sweep": ("sweep_id", "test_points", "rows_scored",
+                    "seconds", "rows_per_s"),
+    "audit.apply": ("plan_id", "action", "status", "reason",
+                    "rows_removed", "rows_reweighted", "seconds"),
 }
 
 # The canonical rejection reasons (fia_tpu/serve/admission.py). The
@@ -59,6 +65,7 @@ def pcts(vals):
 
 def load(path: str):
     reqs, batches, rollups, sampled = [], [], [], []
+    sweeps, applies = [], []
     snapshot = None
     with open(path) as fh:
         for line in fh:
@@ -83,7 +90,11 @@ def load(path: str):
                 # its enclosing span (engine._query_sampled)
                 sampled.extend(e for e in (d.get("events") or [])
                                if e.get("name") == "engine.sampled")
-    return reqs, batches, rollups, snapshot, sampled
+            elif ev == "audit.sweep":
+                sweeps.append(d)
+            elif ev == "audit.apply":
+                applies.append(d)
+    return reqs, batches, rollups, snapshot, sampled, sweeps, applies
 
 
 def hist_pct(h: dict, buckets: list, q: float) -> float:
@@ -172,8 +183,9 @@ def main(argv) -> int:
     if len(argv) != 2:
         print(__doc__.strip(), file=sys.stderr)
         return 2
-    reqs, batches, rollups, snapshot, sampled = load(argv[1])
-    if not reqs and not rollups:
+    (reqs, batches, rollups, snapshot, sampled,
+     sweeps, applies) = load(argv[1])
+    if not reqs and not rollups and not sweeps and not applies:
         print(f"no serving events in {argv[1]}", file=sys.stderr)
         return 1
 
@@ -228,6 +240,29 @@ def main(argv) -> int:
                       default=0.0)
         print(f"sampled rung: dispatches={len(sampled)}  queries={q}  "
               f"escalated={esc}  err_bound_max={err_max:.4g}")
+
+    # audit subsystem (docs/design.md §23): reverse-sweep throughput
+    # and live unlearning applies, from the same metrics stream
+    if sweeps:
+        scored = sum(int(s.get("rows_scored", 0)) for s in sweeps)
+        rps = [float(s["rows_per_s"]) for s in sweeps
+               if s.get("rows_per_s")]
+        mean_rps = f"{np.mean(rps):,.0f}" if rps else "n/a"
+        print(f"audit sweeps: {len(sweeps)}  row-scores={scored}  "
+              f"mean rows/s {mean_rps}  "
+              f"sweep {pcts([1e3 * float(s['seconds']) for s in sweeps])}")
+    if applies:
+        committed = [a for a in applies if a.get("status") == "committed"]
+        rolled = [a for a in applies if a.get("status") != "committed"]
+        removed = sum(int(a.get("rows_removed", 0)) for a in committed)
+        rew = sum(int(a.get("rows_reweighted", 0)) for a in committed)
+        print(f"audit applies: {len(applies)}  "
+              f"committed={len(committed)}  rolled_back={len(rolled)}  "
+              f"rows removed={removed} reweighted={rew}  "
+              f"apply {pcts([1e3 * float(a['seconds']) for a in applies])}")
+        for a in rolled:
+            print(f"  rolled_back[{a.get('plan_id')}]: "
+                  f"{a.get('reason') or '<unreasoned!>'}")
 
     print(f"queue wait: {pcts([r['queue_wait_ms'] for r in ok])}")
     print(f"solve:      {pcts([r['solve_ms'] for r in ok])}")

@@ -46,6 +46,14 @@ bash scripts/churn_smoke.sh || {
   echo "churn-smoke FAILED (run make churn-smoke)"
   exit 1
 }
+# Unlearn smoke, FATAL: the audit subsystem end to end — reverse
+# top-k sweep -> removal plan -> retraining verification -> fenced
+# live apply, with checksummed plan/verdict artifacts
+# (docs/design.md §23).
+bash scripts/unlearn_smoke.sh || {
+  echo "unlearn-smoke FAILED (run make unlearn-smoke)"
+  exit 1
+}
 # Degraded smoke, FATAL: device-loss mesh-shrink recovery must stay
 # bit-identical and the brownout ladder must degrade/recover without
 # flapping (docs/design.md §18).

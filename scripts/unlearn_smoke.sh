@@ -1,0 +1,68 @@
+#!/usr/bin/env bash
+# Unlearn smoke: the audit subsystem end to end (docs/design.md §23).
+# Runs `python -m fia_tpu.cli.debug_data` on a tiny planted-corruption
+# synthetic problem — reverse top-k sweep -> removal plan -> retraining
+# verification -> fenced live apply — then asserts on its JSON summary:
+#   - the sweep scored rows and produced a non-empty removal plan
+#   - the fidelity verdict exists with finite sign/spearman numbers
+#     (this is a MACHINERY check at deliberately weak train/verify
+#     budgets; the gate itself is demonstrated by the committed
+#     artifact from `--gate_demo`, which needs ~10 min of CPU)
+#   - the apply committed through the epoch-fenced loop
+#   - plan + verdict published as checksummed artifacts with manifests
+#
+#   bash scripts/unlearn_smoke.sh        (or: make unlearn-smoke)
+#
+# Budget: <60s on CPU — 60x40 MF, 300 training steps, 150-step verify
+# lanes. Everything lands in a throwaway tmpdir.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+DIR=$(mktemp -d /tmp/fia_unlearn_smoke.XXXXXX)
+trap 'rm -rf "$DIR"' EXIT
+
+JAX_PLATFORMS=cpu timeout -k 10 300 python -m fia_tpu.cli.debug_data \
+  --dataset synthetic --synth_users 60 --synth_items 40 \
+  --synth_train 2000 --synth_test 40 --split_seed 3 --seed 0 \
+  --model MF --embed_size 4 --weight_decay 1e-3 --damping 1e-3 \
+  --lr 1e-2 --batch_size 200 --num_steps_train 300 --solver direct \
+  --corrupt_rows 40 --topk 16 --plan_rows 4 --controls 4 \
+  --verify 1 --verify_steps 150 --retrain_times 2 \
+  --apply 1 --apply_steps 40 --force_apply \
+  --train_dir "$DIR" --json_out "$DIR/unlearn.json" \
+  > "$DIR/stdout.log"
+
+python - "$DIR/unlearn.json" <<'EOF'
+import json
+import math
+import os
+import sys
+
+s = json.load(open(sys.argv[1]))
+
+assert s["rows_scored"] > 0, f"sweep scored nothing: {s}"
+assert s["rows_per_s"] > 0
+assert s["plan_action"] == "remove" and s["plan_rows"] == 4, s
+assert s["predicted_delta"] < 0, \
+    f"a removal plan must predict test-SSE improvement: {s}"
+assert s["planted_hit_rate"] is not None
+
+for key in ("sign_agreement", "spearman"):
+    assert math.isfinite(s[key]), f"{key} not finite: {s[key]}"
+assert isinstance(s["gate_passed"], bool)
+
+assert s["apply_status"] == "committed", \
+    f"fenced apply did not commit: {s.get('apply_status')}"
+
+for art in (s["plan_path"], s["verify_artifact"]):
+    assert os.path.exists(art), f"artifact missing: {art}"
+    assert os.path.exists(art + ".manifest.json"), \
+        f"manifest sidecar missing: {art}.manifest.json"
+
+print(f"unlearn-smoke PASS: {s['rows_scored']} row-scores "
+      f"({s['rows_per_s']:,.0f} rows/s), plan {s['plan_id']} "
+      f"predicted {s['predicted_delta']:+.3f}, planted hit rate "
+      f"{s['planted_hit_rate']:.2f}, verdict sign "
+      f"{s['sign_agreement']:.2f} / spearman {s['spearman']:.2f} "
+      f"(gate_passed={s['gate_passed']}), apply committed")
+EOF

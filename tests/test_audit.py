@@ -1,0 +1,304 @@
+"""Audit subsystem tests: reverse sweep invariances, plan round-trips,
+fenced apply commit/rollback, and retraining-based verification.
+
+The bitwise invariance tests are the audit counterpart of the engine's
+chunking guarantees (docs/design.md §23): a reverse sweep's ranking
+must not depend on how the test stream was chunked, how queries were
+batched, or how many devices the mesh sharded the dispatch over —
+otherwise "the worst training rows" would be an artifact of throughput
+knobs, not of the data.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from fia_tpu.api import FIAModel
+from fia_tpu.audit.plan import (
+    UnlearnPlan,
+    apply_plan,
+    build_plan,
+    load_plan,
+    save_plan,
+)
+from fia_tpu.audit.reverse import SweepResult, reverse_topk
+from fia_tpu.audit.verify import (
+    sign_agreement,
+    spearman,
+    verify_fingerprint,
+    verify_plan,
+)
+from fia_tpu.data.dataset import RatingDataset
+from fia_tpu.influence.engine import InfluenceEngine
+from fia_tpu.parallel.mesh import make_mesh
+from fia_tpu.reliability import inject, sites, taxonomy
+from fia_tpu.reliability import policy as rpolicy
+from fia_tpu.reliability.artifacts import load_npz, read_manifest
+from fia_tpu.reliability.journal import Journal
+
+U, I, K = 30, 20, 4
+WD, DAMP = 1e-2, 1e-3
+N_TRAIN = 240
+STEPS = 8
+
+
+def _data(seed=1, n=N_TRAIN):
+    rng = np.random.default_rng(seed)
+    x = np.stack([rng.integers(0, U, n), rng.integers(0, I, n)],
+                 axis=1).astype(np.int32)
+    y = rng.integers(1, 6, n).astype(np.float32)
+    return x, y
+
+
+@pytest.fixture(scope="module")
+def base_model(tmp_path_factory):
+    """One trained FIAModel shared across tests (compiles paid once);
+    the ``fm`` fixture snapshots/restores its state around each test."""
+    x, y = _data()
+    m = FIAModel(
+        "MF", U, I, K, WD, batch_size=50,
+        data_sets={"train": RatingDataset(x, y)},
+        initial_learning_rate=1e-2, damping=DAMP,
+        train_dir=str(tmp_path_factory.mktemp("audit-base")),
+        model_name="audit-test", solver="direct", seed=0,
+    )
+    m._trainer.clock = rpolicy.VirtualClock()
+    m.train(24, save_checkpoints=False, verbose=False)
+    return m
+
+
+@pytest.fixture()
+def fm(base_model, tmp_path):
+    saved = (base_model.state, base_model.data_sets["train"],
+             base_model.train_dir)
+    base_model.train_dir = str(tmp_path)
+    yield base_model
+    (base_model.state, base_model.data_sets["train"],
+     base_model.train_dir) = saved
+    base_model._engines.clear()
+
+
+def _test_points(fm, n=6):
+    x = np.asarray(fm.data_sets["train"].x, np.int64)[:n]
+    y = np.asarray(fm.data_sets["train"].y, np.float32)[:n]
+    return x, y
+
+
+def _sweep_bytes(r: SweepResult):
+    return (r.row_ids.tobytes(), r.loss_deltas.tobytes(),
+            r.group_scores.tobytes())
+
+
+class TestReverseSweepInvariance:
+    def test_chunking_and_batching_bitwise_invariant(self, fm):
+        pts, ty = _test_points(fm)
+        ref = reverse_topk(fm, pts, ty, k=12)
+        for kwargs in ({"chunk_points": 2, "batch_queries": 2},
+                       {"chunk_points": 3, "batch_queries": 1},
+                       {"batch_queries": 4, "pad_to": 32},
+                       {"segment": 8}):
+            r = reverse_topk(fm, pts, ty, k=12, **kwargs)
+            assert r.sweep_id == ref.sweep_id
+            assert _sweep_bytes(r) == _sweep_bytes(ref), kwargs
+
+    def test_mesh_shard_bitwise_invariant(self, fm):
+        # conftest forces 8 virtual CPU devices; the sweep ranking must
+        # not depend on how many of them the dispatch shards over
+        pts, ty = _test_points(fm)
+        outs = []
+        for ndev in (1, 2, 4):
+            eng = InfluenceEngine(
+                fm.model, fm.state.params, fm.data_sets["train"],
+                damping=DAMP, solver="direct", mesh=make_mesh(ndev),
+            )
+            outs.append(_sweep_bytes(
+                reverse_topk(fm, pts, ty, k=12, engine=eng)))
+        assert outs[0] == outs[1] == outs[2]
+
+    def test_journal_records_and_resume_replays_bitwise(self, fm, tmp_path):
+        pts, ty = _test_points(fm)
+        ref = reverse_topk(fm, pts, ty, k=12, chunk_points=3)
+        path = str(tmp_path / "sweep.journal.jsonl")
+        fp = {"kind": "audit.sweep-test", "sweep_id": ref.sweep_id,
+              "chunk_points": 3}
+        with Journal.open(path, fp, fsync=False) as j:
+            first = reverse_topk(fm, pts, ty, k=12, chunk_points=3,
+                                 journal=j)
+        assert _sweep_bytes(first) == _sweep_bytes(ref)
+        size = os.path.getsize(path)
+        assert size > 0
+        # a resumed sweep answers every query batch from the journal:
+        # bitwise-identical result, zero new records appended
+        with Journal.open(path, fp, resume=True, fsync=False) as j2:
+            resumed = reverse_topk(fm, pts, ty, k=12, chunk_points=3,
+                                   journal=j2)
+        assert _sweep_bytes(resumed) == _sweep_bytes(ref)
+        assert os.path.getsize(path) == size
+
+
+class TestPlan:
+    def test_build_plan_filters_and_caps(self, fm):
+        pts, ty = _test_points(fm)
+        sweep = reverse_topk(fm, pts, ty, k=16)
+        plan = build_plan(fm, sweep, action="remove", max_rows=4)
+        assert plan.rows <= 4
+        assert np.all(plan.per_row_delta < 0)  # only_negative default
+        assert plan.predicted_delta == pytest.approx(
+            float(plan.per_row_delta.sum()))
+        assert plan.train_rows == N_TRAIN
+        assert plan.base_step == int(fm.state.step)
+
+    def test_build_plan_refuses_empty(self, fm):
+        fake = SweepResult(
+            row_ids=np.arange(3, dtype=np.int64),
+            loss_deltas=np.array([0.0, 0.5, 1.0], np.float32),
+            group_scores=np.zeros(N_TRAIN, np.float32), sweep_id="x",
+            test_points=np.zeros((1, 2), np.int64), rows_scored=3,
+            chunks=1, seconds=0.0,
+        )
+        with pytest.raises(ValueError, match="no candidate rows"):
+            build_plan(fm, fake, action="remove")
+
+    def test_build_plan_validates_action_and_reweight(self, fm):
+        pts, ty = _test_points(fm)
+        sweep = reverse_topk(fm, pts, ty, k=8)
+        with pytest.raises(ValueError, match="action"):
+            build_plan(fm, sweep, action="drop")
+        with pytest.raises(ValueError, match="reweight"):
+            build_plan(fm, sweep, action="reweight", reweight=1.0)
+
+    @pytest.mark.parametrize("action,reweight",
+                             [("remove", 0.5), ("reweight", 0.25)])
+    def test_save_load_round_trip(self, fm, tmp_path, action, reweight):
+        pts, ty = _test_points(fm)
+        sweep = reverse_topk(fm, pts, ty, k=8)
+        plan = build_plan(fm, sweep, action=action, max_rows=3,
+                          reweight=reweight)
+        path = save_plan(plan, str(tmp_path / "plan.npz"))
+        back = load_plan(path)
+        assert isinstance(back, UnlearnPlan)
+        assert back.plan_id == plan.plan_id
+        assert back.action == plan.action
+        assert back.reweight == plan.reweight
+        assert back.train_rows == plan.train_rows
+        assert back.base_step == plan.base_step
+        assert back.model_key == plan.model_key
+        assert np.array_equal(back.row_ids, plan.row_ids)
+        assert np.array_equal(back.per_row_delta, plan.per_row_delta)
+        assert np.array_equal(back.test_points, plan.test_points)
+        assert back.predicted_delta == pytest.approx(plan.predicted_delta)
+
+
+def _params_bytes(fm):
+    import jax
+
+    return b"".join(
+        np.ascontiguousarray(np.asarray(leaf)).tobytes()
+        for leaf in jax.tree_util.tree_leaves(fm.state.params))
+
+
+class TestApply:
+    def test_remove_commits_and_shrinks_train_set(self, fm):
+        pts, ty = _test_points(fm)
+        plan = build_plan(fm, reverse_topk(fm, pts, ty, k=8),
+                          action="remove", max_rows=3)
+        before = _params_bytes(fm)
+        r = apply_plan(fm, plan, steps=STEPS, checkpoint_every=4)
+        assert r.committed, (r.status, r.reason)
+        assert len(fm.data_sets["train"].x) == N_TRAIN - plan.rows
+        assert _params_bytes(fm) != before
+        assert int(fm.state.step) > plan.base_step
+
+    def test_reweight_commits_and_softens_labels_in_place(self, fm):
+        pts, ty = _test_points(fm)
+        plan = build_plan(fm, reverse_topk(fm, pts, ty, k=8),
+                          action="reweight", max_rows=3, reweight=0.5)
+        old_y = np.array(fm.data_sets["train"].y)
+        r = apply_plan(fm, plan, steps=STEPS, checkpoint_every=4)
+        assert r.committed, (r.status, r.reason)
+        new_y = np.asarray(fm.data_sets["train"].y)
+        assert len(new_y) == N_TRAIN  # nothing deleted
+        changed = np.flatnonzero(new_y != old_y)
+        assert set(changed) <= set(plan.row_ids.tolist())
+        assert len(changed) > 0
+
+    def test_classified_swap_failure_rolls_back(self, fm):
+        pts, ty = _test_points(fm)
+        plan = build_plan(fm, reverse_topk(fm, pts, ty, k=8),
+                          action="remove", max_rows=3)
+        before = _params_bytes(fm)
+        with inject.active(inject.Fault(sites.STREAM_SWAP, at=0,
+                                        kind=taxonomy.PREEMPTION)):
+            r = apply_plan(fm, plan, steps=STEPS)
+        assert r.status == "rolled_back"
+        assert r.reason == taxonomy.PREEMPTION
+        assert _params_bytes(fm) == before
+        assert len(fm.data_sets["train"].x) == N_TRAIN
+        # the restored train set keeps the plan fresh: the retry commits
+        again = apply_plan(fm, plan, steps=STEPS)
+        assert again.committed
+
+    def test_entry_site_failure_rolls_back_before_any_work(self, fm):
+        pts, ty = _test_points(fm)
+        plan = build_plan(fm, reverse_topk(fm, pts, ty, k=8),
+                          action="remove", max_rows=3)
+        with inject.active(inject.Fault(sites.AUDIT_APPLY, at=0,
+                                        kind=taxonomy.WORKER)):
+            r = apply_plan(fm, plan, steps=STEPS)
+        assert r.status == "rolled_back"
+        assert r.reason == taxonomy.WORKER
+        assert len(fm.data_sets["train"].x) == N_TRAIN
+
+    def test_stale_plan_rejected(self, fm):
+        pts, ty = _test_points(fm)
+        plan = build_plan(fm, reverse_topk(fm, pts, ty, k=8),
+                          action="remove", max_rows=3)
+        assert apply_plan(fm, plan, steps=STEPS).committed
+        # row ids are positional: after the train set changed, the same
+        # plan would delete the wrong interactions — refused at the door
+        with pytest.raises(ValueError, match="stale plan"):
+            apply_plan(fm, plan, steps=STEPS)
+        with pytest.raises(ValueError, match="stale plan"):
+            verify_plan(fm, plan, pts, ty, num_steps=2, retrain_times=1)
+
+
+class TestVerify:
+    def test_rank_helpers(self):
+        a = np.array([3.0, 1.0, 2.0])
+        assert spearman(a, a) == pytest.approx(1.0)
+        assert spearman(a, -a) == pytest.approx(-1.0)
+        assert sign_agreement(np.array([-1.0, 2.0]),
+                              np.array([-0.5, 0.1])) == pytest.approx(1.0)
+        assert sign_agreement(np.array([-1.0, 2.0]),
+                              np.array([0.5, 0.1])) == pytest.approx(0.5)
+
+    def test_verify_runs_journals_and_publishes(self, fm, tmp_path):
+        pts, ty = _test_points(fm)
+        plan = build_plan(fm, reverse_topk(fm, pts, ty, k=8),
+                          action="remove", max_rows=2)
+        kw = dict(num_steps=20, batch_size=50, learning_rate=1e-3,
+                  retrain_times=2, max_rows=2, seed=0)
+        jpath = str(tmp_path / "verify.journal.jsonl")
+        apath = str(tmp_path / "verify.npz")
+        fp = verify_fingerprint(fm, plan, pts, **kw)
+        with Journal.open(jpath, fp, fsync=False) as j:
+            res = verify_plan(fm, plan, pts, ty, journal=j,
+                              artifact_path=apath, **kw)
+        assert np.all(np.isfinite(res.actual))
+        assert len(res.predicted) == len(res.actual) == 2
+        assert -1.0 <= res.spearman <= 1.0
+        assert 0.0 <= res.sign_agreement <= 1.0
+        # the committed verdict artifact round-trips with its manifest
+        arrays = load_npz(apath, require_manifest=True)
+        assert np.array_equal(arrays["row_ids"], res.row_ids)
+        man = read_manifest(apath)
+        assert man["fingerprint"]["plan_id"] == plan.plan_id
+        # resume: every retraining lane chunk comes from the journal —
+        # bitwise-identical verdict, zero retrain compute re-spent
+        size = os.path.getsize(jpath)
+        with Journal.open(jpath, fp, resume=True, fsync=False) as j2:
+            res2 = verify_plan(fm, plan, pts, ty, journal=j2, **kw)
+        assert res2.actual.tobytes() == res.actual.tobytes()
+        assert res2.sign_agreement == res.sign_agreement
+        assert os.path.getsize(jpath) == size

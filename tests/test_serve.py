@@ -596,6 +596,51 @@ class TestMultiTenant:
         assert lane["requests"] == 8 and lane["ok"] == 4
         assert lane["rejected"] == {"overload": 4}
 
+    def test_tenant_quota_flood_sheds_only_the_noisy_tenant(self):
+        """A single tenant flooding past its quota sheds tenant-tagged
+        overload while its OWN class's other tenants (and unlabelled
+        traffic) keep their headroom — the per-tenant bound under the
+        class quotas."""
+        model, params, train = _setup()
+        pts = _unique_points(train, 14)
+        eng = _engine(model, params, train)
+        svc = _service(eng, max_batch=4, max_queue=8,
+                       tenant_quotas={"acme": 0.25})
+        assert svc.admission.tenant_caps["acme"] == 2
+        rejected = []
+        for j, (u, i) in enumerate(pts[:6]):
+            r = svc.submit(Request(int(u), int(i), id=f"a{j}",
+                                   cls="batch", tenant="acme"))
+            if r is not None:
+                rejected.append(r)
+        assert len(rejected) == 4
+        for r in rejected:
+            assert r.reason == "overload"
+            assert r.tenant == "acme" and r.cls == "batch"
+            assert r.json()["tenant"] == "acme"
+        # same class, other tenant / unlabelled: quota untouched
+        for j, (u, i) in enumerate(pts[6:9]):
+            assert svc.submit(Request(int(u), int(i), id=f"b{j}",
+                                      cls="batch", tenant="beta")) is None
+        for j, (u, i) in enumerate(pts[9:12]):
+            assert svc.submit(Request(int(u), int(i),
+                                      id=f"u{j}", cls="batch")) is None
+        out = {r.id: r for r in svc.drain()}
+        assert all(out[f"a{j}"].ok for j in range(2))
+        assert all(out[f"b{j}"].ok for j in range(3))
+        assert all(out[f"u{j}"].ok for j in range(3))
+        # the depth counter reset with the drain: the tenant's lane is
+        # usable again on the next wave
+        u, i = (int(v) for v in pts[12])
+        assert svc.submit(Request(u, i, id="a-next",
+                                  cls="batch", tenant="acme")) is None
+
+    def test_tenant_quota_validation(self):
+        model, params, train = _setup()
+        eng = _engine(model, params, train)
+        with pytest.raises(ValueError, match="tenant quota"):
+            _service(eng, tenant_quotas={"acme": 1.5})
+
     def test_unknown_class_rejected_invalid(self):
         model, params, train = _setup()
         u, i = (int(v) for v in _unique_points(train, 1)[0])

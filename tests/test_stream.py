@@ -102,14 +102,19 @@ class TestFootprint:
         # rows: u0-i0, u1-i0, u2-i1; update adds u0-i1
         train_x = np.array([[0, 0], [1, 0], [2, 1]], np.int32)
         fp = compute_footprint(train_x, np.array([[0, 1]], np.int32), 5, 4)
-        # u0 (direct), u2 (shares i1); i1 (direct), i0 (shared by u0)
+        # moved rows: u0 (direct), u2 (shares i1); i1 (direct), i0
+        # (shared by u0) — the set the projection keeps fine-tuned
         assert set(np.flatnonzero(fp.user_touched)) == {0, 2}
         assert set(np.flatnonzero(fp.item_touched)) == {0, 1}
-        # u1 reads i0's column, so any (u1, *) block with i0 is touched
         assert fp.touched(1, 0)
-        # but u1 against an untouched item is not
-        assert not fp.touched(1, 2)
+        # u1's own row is pinned, but its (1, *) block Hessians gather
+        # Q[0] through row (1, 0) and i0 moved — the READ reach is one
+        # hop wider than the moved set, and invalidation keys on it
+        assert set(np.flatnonzero(fp.user_read)) == {0, 1, 2}
+        assert fp.touched(1, 2)
+        # u3 has no rows at all: reads nothing that moved
         assert not fp.touched(3, 3)
+        assert not fp.touched(3, 2)
 
     def test_touched_pairs_vectorized_matches_scalar(self):
         x, y = _community_data(n=60)
