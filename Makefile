@@ -1,7 +1,7 @@
 # Convenience targets. The commands themselves are pinned in
 # ROADMAP.md (tier-1) and scripts/ — these targets just name them.
 
-.PHONY: tier1 test lint lint-io serve-smoke serve-soak multichip-smoke factor-smoke chaos-smoke chaos-soak churn-smoke unlearn-smoke degraded-smoke approx-smoke kernel-smoke scale-smoke obs-smoke
+.PHONY: tier1 test lint lint-io lint-determinism serve-smoke serve-soak multichip-smoke factor-smoke chaos-smoke chaos-soak churn-smoke unlearn-smoke degraded-smoke approx-smoke kernel-smoke scale-smoke obs-smoke
 
 # The ROADMAP.md tier-1 verify: fast CPU suite, slow tests excluded.
 # Lint is fatal — a finding fails the build before pytest runs.
@@ -14,8 +14,9 @@ test:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -p no:cacheprovider
 
 # The AST lint engine: raw-write discipline, jit trace hygiene,
-# fault-site integrity, metrics schema drift. docs/lint.md has the
-# rule catalog; `# fialint: disable=RULE -- why` suppresses a line.
+# fault-site integrity, metrics schema drift, call-graph determinism
+# flows. docs/lint.md has the rule catalog;
+# `# fialint: disable=RULE -- why` suppresses a line.
 lint:
 	python -m fia_tpu.analysis.lint fia_tpu scripts bench.py
 
@@ -23,6 +24,13 @@ lint:
 # just the raw-write rule (FIA101) of the engine above.
 lint-io:
 	python -m fia_tpu.analysis.lint --select FIA101 fia_tpu scripts bench.py
+
+# The FIA5xx bitwise-contract family alone: interprocedural
+# source→sink determinism flows (unseeded RNG / wall-clock / fs order /
+# unsorted JSON / set order / id() ordering reaching byte-pinned
+# outputs). FIA5 is a family prefix — new FIA5xx rules join it.
+lint-determinism:
+	python -m fia_tpu.analysis.lint --select FIA5 fia_tpu scripts bench.py
 
 # Serving smoke: 200-query synthetic stream through fia_tpu.cli.serve
 # on CPU (<60s) — zero unreasoned drops, hot-cache hits, latency report.

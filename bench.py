@@ -613,6 +613,7 @@ def _maybe_json_out(out: dict) -> None:
     else:
         from fia_tpu.utils.io import save_json_atomic
 
+        # fialint: disable=FIA502 -- benchmark report: wall-clock latencies are the measurement payload, not leakage
         save_json_atomic(sys.argv[idx], out)
 
 
@@ -2124,9 +2125,10 @@ def _lint_preflight() -> None:
     """``--lint``: fail fast on lint findings before burning device time.
 
     Runs the AST lint engine (fia_tpu/analysis) over the package,
-    scripts/ and this file — the same scope as ``make lint`` — and
-    exits 2 on findings so an orchestration sweep aborts before the
-    first compile rather than after the last measurement.
+    scripts/ and this file — the same scope as ``make lint``, which
+    includes the FIA5xx call-graph determinism family — and exits 2 on
+    findings so an orchestration sweep aborts before the first compile
+    rather than after the last measurement.
     """
     import contextlib
 

@@ -156,6 +156,133 @@ OBS_CONSUMERS = (
     "fia_tpu/cli/obs.py",
 )
 
+# ---------------------------------------------------------------------
+# FIA5xx — call-graph determinism family (docs/lint.md, docs/design.md
+# §24). Sources are nondeterministic reads; sinks are the things the
+# repo byte-pins (published artifacts, fingerprints, cache keys,
+# metrics events, dispatch-path return values). The dataflow engine
+# (analysis/dataflow.py) flags a source only when its value *reaches*
+# a sink through the project call graph.
+# ---------------------------------------------------------------------
+
+# FIA501: draws through numpy's legacy global generator
+# (np.random.rand & friends). The new-style Generator API is exempt
+# when seeded — these attrs construct deterministic streams.
+NP_RANDOM_DETERMINISTIC_ATTRS = frozenset({
+    "default_rng", "Generator", "Philox", "PCG64", "PCG64DXSM",
+    "MT19937", "SFC64", "SeedSequence", "BitGenerator", "RandomState",
+})
+# constructors that are deterministic ONLY when given a seed argument;
+# the zero-argument form seeds from the OS and is a source.
+RNG_SEEDED_CONSTRUCTORS = frozenset({
+    "numpy.random.default_rng", "numpy.random.RandomState",
+    "random.Random",
+})
+# stdlib ``random`` module-level draws (the hidden global Mersenne
+# state; ``random.Random(seed).x()`` through an instance is fine).
+RANDOM_MODULE_FNS = frozenset({
+    "random", "randint", "randrange", "uniform", "choice", "choices",
+    "sample", "shuffle", "getrandbits", "gauss", "normalvariate",
+    "betavariate", "expovariate", "triangular", "vonmisesvariate",
+    "paretovariate", "weibullvariate", "lognormvariate", "randbytes",
+})
+# unconditionally nondeterministic value reads.
+ALWAYS_RANDOM_CALLS = frozenset({
+    "uuid.uuid4", "uuid.uuid1", "secrets.token_bytes",
+    "secrets.token_hex", "secrets.token_urlsafe", "secrets.randbelow",
+    "os.urandom",
+})
+
+# FIA502: wall-clock reads. Production time flows through the
+# injectable Clock seam (reliability/policy.py WALL/VirtualClock);
+# reads through a clock *object* don't match here by construction
+# (they resolve to the object attribute, not the time module), and the
+# seam module itself — the one sanctioned place that touches
+# time.monotonic — is exempted below.
+WALLCLOCK_CALLS = frozenset({
+    "time.time", "time.time_ns", "time.monotonic", "time.monotonic_ns",
+    "time.perf_counter", "time.perf_counter_ns", "time.process_time",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+    "datetime.datetime.today", "datetime.date.today",
+})
+WALLCLOCK_SEAM_FILES = ("fia_tpu/reliability/policy.py",)
+
+# FIA503: arbitrarily-ordered filesystem enumerations (os.listdir
+# order is filesystem-dependent; glob inherits it).
+FS_ORDER_CALLS = frozenset({
+    "os.listdir", "os.scandir", "os.walk", "glob.glob", "glob.iglob",
+})
+FS_ORDER_METHOD_ATTRS = frozenset({"iterdir", "rglob"})
+
+# FIA506: process-varying identity/ordering primitives.
+ID_HASH_CALLS = frozenset({"id", "hash"})
+
+# Sanitizers. KILL_ORDER: result independent of argument *order*
+# (kills FIA503/505/506-order taint, keeps FIA501/502 value taint —
+# a sorted list of random numbers is still random). KILL_ALL: result
+# deterministic regardless (shape/structure probes).
+SANITIZE_ORDER_CALLS = frozenset({
+    "sorted", "min", "max", "sum", "any", "all", "set", "frozenset",
+    "collections.Counter", "Counter",
+})
+SANITIZE_ALL_CALLS = frozenset({
+    "len", "isinstance", "hasattr", "callable", "type",
+})
+
+# Sink *functions*: project-internal defs whose arguments end up
+# byte-pinned — matched through the call graph, so a jit wrapper, an
+# import alias or a cross-module from-import still resolves to the
+# registered sink. Entries are (path suffix, qualpath, description).
+DETERMINISM_SINK_FUNCTIONS = (
+    ("fia_tpu/utils/io.py", "save_npz_atomic", "published artifact"),
+    ("fia_tpu/utils/io.py", "save_json_atomic", "published artifact"),
+    ("fia_tpu/utils/io.py", "save_text_atomic", "published artifact"),
+    ("fia_tpu/utils/io.py", "savetxt_atomic", "published artifact"),
+    ("fia_tpu/reliability/artifacts.py", "publish_npz",
+     "published artifact"),
+    ("fia_tpu/reliability/artifacts.py", "canonical_fingerprint",
+     "artifact fingerprint"),
+    ("fia_tpu/reliability/artifacts.py", "rewrite_fingerprint",
+     "artifact fingerprint"),
+    ("fia_tpu/reliability/journal.py", "Journal.record",
+     "journal entry"),
+    ("fia_tpu/reliability/journal.py", "Journal.open",
+     "journal fingerprint"),
+    ("fia_tpu/serve/cache.py", "disk_put", "disk cache entry"),
+    ("fia_tpu/serve/cache.py", "disk_entry_path", "cache key path"),
+    ("fia_tpu/serve/cache.py", "disk_fingerprint", "cache fingerprint"),
+    ("fia_tpu/train/checkpoint.py", "save", "checkpoint artifact"),
+    ("fia_tpu/train/checkpoint.py", "save_rotated",
+     "checkpoint artifact"),
+)
+
+# Name-based sink fallback for calls the graph cannot resolve to a
+# project def (fixture trees without the io module, attribute calls on
+# objects). Keys are the *last* component of the canonical callee.
+DETERMINISM_SINK_CALL_NAMES = {
+    "save_npz_atomic": "published artifact",
+    "save_json_atomic": "published artifact",
+    "save_text_atomic": "published artifact",
+    "savetxt_atomic": "published artifact",
+    "publish_npz": "published artifact",
+    "canonical_fingerprint": "artifact fingerprint",
+    "disk_put": "disk cache entry",
+}
+
+# Functions whose RETURN VALUE is byte-pinned (the sharded-vs-
+# replicated identity contract pins exact bytes out of the dispatch
+# path): a tainted return is a finding. Seeded from the FIA204/205
+# dispatch registry; (path suffix, name) pairs.
+DETERMINISM_SINK_RETURNS = DISPATCH_PATH_FUNCTIONS
+
+# Metrics SCHEMA events (``*.log("event.name", field=...)``) are sinks
+# for the ORDER/RNG rules only — wall-clock values flowing into events
+# are the observability contract itself (``t`` is an implicit field),
+# so FIA502 does not treat event emission as a sink.
+METRICS_EVENT_SINK_RULES = frozenset({
+    "FIA501", "FIA503", "FIA505", "FIA506",
+})
+
 # FIA402: bare ``print(`` is banned in library code under this prefix —
 # stdout belongs to CLI mains (machine-readable JSON lines), and
 # human-facing diagnostics must ride the obs spine (fia_tpu.obs.diag:

@@ -140,6 +140,7 @@ def _load_builtin_rules() -> None:
     _loaded = True
     # import for registration side effects
     from fia_tpu.analysis import (  # noqa: F401
+        rules_determinism,
         rules_io,
         rules_obs,
         rules_schema,
@@ -270,6 +271,75 @@ def load_source_file(path: str, root: str) -> SourceFile:
     return sf
 
 
+class LintContext:
+    """Per-invocation shared state: the parsed-module cache and rule
+    memos.
+
+    Before this existed every ProjectRule that needed a registry module
+    (FIA301/FIA303 both parse ``sites.py``, FIA401 parses the metrics
+    module, the obs schema and every consumer) re-opened and re-parsed
+    it from disk once per rule invocation — even though the very same
+    file was already sitting, parsed, in the invocation's file list.
+    The context indexes the collected :class:`SourceFile` set by
+    repo-relative path and lazily loads (then caches) anything outside
+    it, so one ``make lint`` parses each file exactly once. ``memo``
+    gives expensive cross-rule artifacts (the FIA5xx call-graph +
+    dataflow run, shared by six rules) the same once-per-invocation
+    lifetime.
+    """
+
+    def __init__(self, files: list[SourceFile], root: str):
+        self.files = files
+        self.root = root
+        self._by_rel: dict[str, SourceFile] = {sf.rel: sf for sf in files}
+        self._memos: dict[str, object] = {}
+
+    def module(self, rel: str) -> SourceFile | None:
+        """The parsed module at repo-relative ``rel`` (cached), or None
+        when the file is missing. Parse failures return the SourceFile
+        with ``tree=None`` — callers distinguish missing from broken."""
+        sf = self._by_rel.get(rel)
+        if sf is None:
+            path = os.path.join(self.root, rel.replace("/", os.sep))
+            if not os.path.isfile(path):
+                return None
+            sf = load_source_file(path, self.root)
+            self._by_rel[rel] = sf
+        return sf
+
+    def memo(self, key: str, build):
+        """``build()`` once per invocation, cached under ``key``."""
+        if key not in self._memos:
+            self._memos[key] = build()
+        return self._memos[key]
+
+
+# The active invocation's context. lint_paths installs one for the
+# duration of the rule run; rules reach it via current_context() so the
+# ProjectRule.check_project(files, root) signature stays stable.
+_CONTEXT: LintContext | None = None
+
+
+def current_context() -> LintContext | None:
+    return _CONTEXT
+
+
+def parsed_module(root: str, rel: str) -> ast.AST | None:
+    """Parsed AST of the module at ``rel`` under ``root``, or None when
+    missing/unparseable. Served from the active invocation's parse
+    cache when one is installed (the ``lint_paths`` rule run), so
+    registry modules already collected for linting are never re-read
+    from disk; falls back to a direct load for standalone callers."""
+    ctx = _CONTEXT
+    if ctx is not None and ctx.root == root:
+        sf = ctx.module(rel)
+        return sf.tree if sf is not None else None
+    path = os.path.join(root, rel.replace("/", os.sep))
+    if not os.path.isfile(path):
+        return None
+    return load_source_file(path, root).tree
+
+
 @dataclass
 class LintResult:
     findings: list[Finding]
@@ -324,17 +394,22 @@ def lint_paths(
     root = root or find_root(paths)
     files = [load_source_file(p, root) for p in collect_files(paths)]
 
-    raw: list[Finding] = []
-    for sf in files:
-        raw.extend(sf.engine_findings)
-        if sf.tree is None:
-            continue
+    global _CONTEXT
+    prev_ctx, _CONTEXT = _CONTEXT, LintContext(files, root)
+    try:
+        raw: list[Finding] = []
+        for sf in files:
+            raw.extend(sf.engine_findings)
+            if sf.tree is None:
+                continue
+            for rule in active.values():
+                if isinstance(rule, FileRule):
+                    raw.extend(rule.check(sf))
         for rule in active.values():
-            if isinstance(rule, FileRule):
-                raw.extend(rule.check(sf))
-    for rule in active.values():
-        if isinstance(rule, ProjectRule):
-            raw.extend(rule.check_project(files, root))
+            if isinstance(rule, ProjectRule):
+                raw.extend(rule.check_project(files, root))
+    finally:
+        _CONTEXT = prev_ctx
 
     supp_map = {sf.rel: sf.suppressions for sf in files}
     kept: list[Finding] = []

@@ -40,7 +40,7 @@ from __future__ import annotations
 import ast
 import os
 
-from fia_tpu.analysis import config
+from fia_tpu.analysis import config, core
 from fia_tpu.analysis.core import Finding, ProjectRule, SourceFile, register
 from fia_tpu.analysis.visitor import const_str, literal_or_none
 
@@ -48,14 +48,14 @@ from fia_tpu.analysis.visitor import const_str, literal_or_none
 def _load_decl(root: str, rel: str, name: str):
     """literal_eval a module-level ``NAME = {...}`` declaration.
 
-    Returns ``(mapping, lineno)`` or ``(None, reason)``.
+    Returns ``(mapping, lineno)`` or ``(None, reason)``. The module
+    comes from the invocation parse cache (``core.parsed_module``) —
+    the schema/consumer files are already in the lint file set, so
+    this never re-parses them from disk.
     """
-    path = os.path.join(root, rel)
-    try:
-        with open(path, encoding="utf-8") as fh:
-            tree = ast.parse(fh.read(), filename=path)
-    except (OSError, SyntaxError) as e:
-        return None, f"{rel} unreadable ({e.__class__.__name__})"
+    tree = core.parsed_module(root, rel)
+    if tree is None:
+        return None, f"{rel} missing or unparseable"
     for node in tree.body:
         if isinstance(node, ast.Assign) and len(node.targets) == 1 and (
             isinstance(node.targets[0], ast.Name)

@@ -27,7 +27,7 @@ import ast
 import os
 import re
 
-from fia_tpu.analysis import config
+from fia_tpu.analysis import config, core
 from fia_tpu.analysis.core import (
     FileRule,
     Finding,
@@ -39,17 +39,24 @@ from fia_tpu.analysis.visitor import call_name, const_str
 
 
 def load_site_registry(root: str) -> tuple[set[str], set[str]] | None:
-    """Parse sites.py without importing it.
+    """Read sites.py's registry from the invocation parse cache.
 
     Returns ``(site_names, constant_names)`` — the string values in
     ``ALL_SITES``-style constants and the constant identifiers — or
-    None when the module is missing/unparseable.
+    None when the module is missing/unparseable. Both FIA301 and
+    FIA303 need this, so the parsed module comes from
+    :func:`core.current_context` (one parse per ``make lint``, shared
+    across rules) and the *registry extraction* itself is memoized.
     """
-    path = os.path.join(root, config.SITES_MODULE)
-    try:
-        with open(path, encoding="utf-8") as fh:
-            tree = ast.parse(fh.read(), filename=path)
-    except (OSError, SyntaxError):
+    ctx = core.current_context()
+    if ctx is not None and ctx.root == root:
+        return ctx.memo("sites-registry", lambda: _extract_registry(root))
+    return _extract_registry(root)
+
+
+def _extract_registry(root: str) -> tuple[set[str], set[str]] | None:
+    tree = core.parsed_module(root, config.SITES_MODULE)
+    if tree is None:
         return None
     names: set[str] = set()
     constants: set[str] = set()

@@ -229,7 +229,7 @@ class Injector:
             # fialint: disable=FIA101 -- deliberate corruption: the fault injector must bypass the atomic-write layer to plant a stale manifest
             with open(manifest_path, "w") as fh:
                 # fialint: disable=FIA101 -- part of the same deliberate corruption write
-                json.dump(m, fh)
+                json.dump(m, fh, sort_keys=True)
 
     def unfired(self) -> list[Fault]:
         return [f for f in self.faults if not f.fired]

@@ -112,7 +112,8 @@ class Journal:
         the provenance rules); a missing or headerless/corrupt file
         degrades to a fresh journal (there is nothing safe to reuse).
         """
-        fingerprint = json.loads(json.dumps(pack(fingerprint)))
+        fingerprint = json.loads(json.dumps(pack(fingerprint),
+                                            sort_keys=True))
         entries: dict[str, object] = {}
         corrupt = 0
         exists = os.path.exists(path)
@@ -174,10 +175,14 @@ class Journal:
         """Durably mark ``key`` complete (one fsynced appended line)."""
         packed = pack(payload)
         self._append({"kind": "done", "key": str(key), "payload": packed})
-        self.entries[str(key)] = unpack(json.loads(json.dumps(packed)))
+        self.entries[str(key)] = unpack(
+            json.loads(json.dumps(packed, sort_keys=True))
+        )
 
     def _append(self, rec: dict) -> None:
-        self._fh.write(json.dumps(rec) + "\n")
+        # sort_keys: a replayed journal must be byte-identical to the
+        # original, so line bytes can't follow dict construction order
+        self._fh.write(json.dumps(rec, sort_keys=True) + "\n")
         self._fh.flush()
         if getattr(self, "_fsync", True):
             os.fsync(self._fh.fileno())

@@ -126,7 +126,9 @@ def save_json_atomic(path: str, obj, *, indent: int | None = None) -> str:
     """
     return _write_atomic(
         path, ".jsontmp.", ".json",
-        lambda f: json.dump(obj, f, indent=indent),
+        # sort_keys pins the byte stream to the content, not to dict
+        # construction order (FIA504: fingerprints hash these bytes)
+        lambda f: json.dump(obj, f, indent=indent, sort_keys=True),
     )
 
 

@@ -276,6 +276,7 @@ def main():
 
     print(json.dumps(out))
     if args.out:
+        # fialint: disable=FIA502 -- A/B timing report: wall-clock latencies are the measurement payload
         save_json_atomic(args.out, out)
 
 

@@ -211,6 +211,7 @@ def main():
     res["agreement"] = {
         n: round(v, 3) for n, v in vals.items()
     }
+    # fialint: disable=FIA502 -- layout A/B report: wall-clock timings are the measurement payload
     save_json_atomic(args.out, res, indent=2)
 
 

@@ -247,6 +247,7 @@ def main():
         "implied_GBps_at_4KB_per_row": round(
             4096 / (ns_per_row * 1e-9) / 1e9, 1) if ns_per_row > 0 else None,
     }
+    # fialint: disable=FIA502 -- limiter sweep report: wall-clock timings are the measurement payload
     save_json_atomic(args.out, out, indent=1)
     log(f"wrote {args.out}")
     print(json.dumps(out["fit"]))

@@ -180,6 +180,7 @@ def main():
                                f"{len(points)} of bench.py's 256",
         },
     }
+    # fialint: disable=FIA502 -- pinned-baseline report: wall-clock throughput is the measurement payload
     save_json_atomic(args.out, out, indent=1)
     print(json.dumps({"scores_per_sec": out["mf"]["scores_per_sec"],
                       "queries": len(points),

@@ -10,8 +10,10 @@ set -o pipefail
 cd "$(dirname "$0")/.."
 rm -f /tmp/_t1.log
 # Lint first, FATAL: a raw write, trace-hygiene hazard, unregistered
-# injection site, or metrics-schema drift fails tier-1 before pytest
-# runs. docs/lint.md has the rule catalog.
+# injection site, metrics-schema drift, or a FIA5xx determinism flow
+# (an unseeded RNG draw / wall-clock read / unsorted listing reaching
+# a byte-pinned artifact, fingerprint, or cache key) fails tier-1
+# before pytest runs. docs/lint.md has the rule catalog.
 python -m fia_tpu.analysis.lint fia_tpu scripts bench.py || {
   echo "fialint FAILED (see findings above; docs/lint.md for the rules)"
   exit 1
