@@ -1,7 +1,7 @@
 # Convenience targets. The commands themselves are pinned in
 # ROADMAP.md (tier-1) and scripts/ — these targets just name them.
 
-.PHONY: tier1 test lint lint-io lint-determinism serve-smoke serve-soak multichip-smoke factor-smoke chaos-smoke chaos-soak churn-smoke unlearn-smoke degraded-smoke approx-smoke kernel-smoke scale-smoke obs-smoke
+.PHONY: tier1 test lint lint-io lint-determinism serve-smoke serve-soak multichip-smoke multihost-smoke factor-smoke chaos-smoke chaos-soak churn-smoke unlearn-smoke degraded-smoke approx-smoke kernel-smoke scale-smoke obs-smoke
 
 # The ROADMAP.md tier-1 verify: fast CPU suite, slow tests excluded.
 # Lint is fatal — a finding fails the build before pytest runs.
@@ -43,6 +43,14 @@ serve-smoke:
 # single-device. docs/design.md §15 has the mesh design.
 multichip-smoke:
 	bash scripts/multichip_smoke.sh
+
+# Multi-host smoke: the journal-transport host-sharded dispatch path
+# across two real OS processes on CPU (<90s) — cross-host bitwise
+# identity vs a single-process reference, zero steady-state compiles
+# per host, resume-from-journal, and the host_loss_recovery chaos
+# drill. docs/design.md §25 has the multi-host design.
+multihost-smoke:
+	bash scripts/multihost_smoke.sh
 
 # Factor smoke: build a tiny factor bank on CPU (<60s), serve against
 # it in-process — verified artifact load, bank hits at Spearman >= 0.999

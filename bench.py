@@ -1772,6 +1772,179 @@ def multichip_main():
     _maybe_json_out(out)
 
 
+def multihost_main():
+    """``python bench.py multihost [--quick] [--json_out PATH]`` — the
+    multi-host pod serving artifact (docs/design.md §25).
+
+    On CPU hosts run under virtual devices:
+      XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
+          JAX_PLATFORMS=cpu python bench.py multihost --quick
+    Two stages, ONE JSON line:
+
+    - ``host_shard``: the journal-transport sharded dispatch of one
+      coalesced order across 1 and 2 hosts (simulated in-process, each
+      host's compute timed separately — the max over hosts is the pod
+      wall under perfect overlap, which is what zero hot-path
+      collectives buys). Rows carry per-host compute wall, journal
+      merge overhead, scores/s, and a bitwise-identity check of the
+      2-host merge against the 1-host run.
+    - ``host_loss``: recovery time to first answer — a WARM service on
+      an 8-device mesh under a 4-host virtual overlay takes one
+      injected ``host_lost`` on dispatch; the drain's wall time over an
+      identical-size fault-free drain is the recovery cost (shrink to
+      survivors + rebuild + AOT re-arm + re-dispatch). In a synchronous
+      drain every answer lands together, so the overhead IS the added
+      time to the first answer.
+    """
+    _ensure_live_backend()
+    import tempfile
+
+    import jax
+
+    from fia_tpu.data.synthetic import sample_heldout_pairs, synthesize_ratings
+    from fia_tpu.influence.engine import InfluenceEngine
+    from fia_tpu.models import MF
+    from fia_tpu.serve import hostshard
+    from fia_tpu.train.trainer import Trainer, TrainConfig
+
+    if QUICK:
+        users, items, rows, steps, n_q = 300, 200, 20_000, 800, 256
+    else:
+        users, items, rows, steps, n_q = 600, 400, 50_000, 3_000, 1024
+    k, wd, damping, max_batch = 16, 1e-3, 1e-6, 32
+
+    _stage(f"multihost bench: backend={jax.default_backend()} "
+           f"devices={jax.device_count()}; training {steps} steps")
+    train = synthesize_ratings(users, items, rows, seed=0)
+    model = MF(users, items, k, wd)
+    tr = Trainer(model, TrainConfig(batch_size=2000, num_steps=steps,
+                                    learning_rate=1e-2))
+    state = tr.fit(tr.init_state(model.init_params(jax.random.PRNGKey(0))),
+                   train.x, train.y)
+    pool = np.asarray(
+        sample_heldout_pairs(train.x, users, items, n_q, seed=31), np.int64)
+
+    eng = InfluenceEngine(model, state.params, train, damping=damping,
+                          model_name="bench-multihost",
+                          kernel="xla_analytic")
+    # warm every pad bucket of the shared dispatch order once, so the
+    # timed shard dispatches below measure steady-state compute
+    eng.query_many(pool, batch_queries=max_batch)
+
+    shard_rows = []
+    merged_by_n = {}
+    with tempfile.TemporaryDirectory(prefix="fia-bench-multihost") as jdir:
+        for nhosts in (1, 2):
+            tag = f"bench{nhosts}"
+            host_walls = []
+            for h in range(nhosts):
+                t0 = time.perf_counter()
+                hostshard.dispatch_local_shard(
+                    eng, pool, host=h, nhosts=nhosts, journal_dir=jdir,
+                    tag=tag, engine_fp="bench-multihost",
+                    max_batch=max_batch)
+                host_walls.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            merged = hostshard.merge_host_shards(
+                jdir, tag, nhosts, pool, engine_fp="bench-multihost",
+                max_batch=max_batch, timeout_s=5.0)
+            merge_s = time.perf_counter() - t0
+            merged_by_n[nhosts] = merged
+            pod_wall = max(host_walls) + merge_s
+            shard_rows.append({
+                "nhosts": nhosts,
+                "host_walls_s": [round(t, 4) for t in host_walls],
+                "merge_s": round(merge_s, 4),
+                "pod_wall_s": round(pod_wall, 4),
+                "scores_per_sec": round(merged["scores"].size / pod_wall, 1),
+            })
+            _stage(f"host_shard nhosts={nhosts}: pod wall "
+                   f"{pod_wall:.3f}s ({shard_rows[-1]['scores_per_sec']} "
+                   "scores/s)")
+    cross_host_identical = all(
+        np.array_equal(merged_by_n[1][key], merged_by_n[2][key])
+        for key in ("scores", "counts", "ihvp", "test_grad"))
+
+    host_loss = _multihost_loss_stage(model, state.params, train, pool,
+                                      damping, max_batch)
+
+    out = {
+        "metric": "fia-influence 2-host sharded dispatch throughput "
+                  "(MF k=16, journal transport)",
+        "value": shard_rows[-1]["scores_per_sec"],
+        "unit": "scores/sec",
+        "details": {
+            "backend": jax.default_backend(),
+            "device_count": jax.device_count(),
+            "host_shard": {"rows": shard_rows,
+                           "cross_host_identical": cross_host_identical},
+            "host_loss": host_loss,
+        },
+    }
+    print(json.dumps(out))
+    _maybe_json_out(out)
+
+
+def _multihost_loss_stage(model, params, train, pool, damping,
+                          max_batch) -> dict:
+    """Recovery-time-to-first-answer under one injected host loss (the
+    ``host_loss`` stage of ``multihost_main``)."""
+    import jax
+
+    from fia_tpu.influence.engine import InfluenceEngine
+    from fia_tpu.parallel import mesh as pmesh
+    from fia_tpu.reliability import inject, sites, taxonomy
+    from fia_tpu.serve import InfluenceService, Request, ServeConfig
+
+    ndev = min(8, jax.device_count())
+    if ndev < 2:
+        return {"skipped": f"needs >= 2 devices, have {ndev}"}
+    overlay = {int(d.id): int(d.id) // max(ndev // 4, 1)
+               for d in jax.devices()[:ndev]}
+    with pmesh.virtual_hosts(overlay):
+        mesh = pmesh.make_mesh(ndev)
+        eng = InfluenceEngine(model, params, train, damping=damping,
+                              model_name="bench-multihost-loss",
+                              mesh=mesh, kernel="xla_analytic")
+        svc = InfluenceService(
+            engine=eng,
+            config=ServeConfig(max_batch=max_batch, max_queue=4096,
+                               mesh=mesh))
+        keys = [(int(u), int(i)) for u, i in pool[: 3 * max_batch]]
+        wave_warm, wave_clean, wave_fault = (
+            keys[:max_batch], keys[max_batch:2 * max_batch],
+            keys[2 * max_batch:])
+
+        def drain(wave, label):
+            reqs = [Request(u, i, id=f"{label}{n}")
+                    for n, (u, i) in enumerate(wave)]
+            t0 = time.perf_counter()
+            responses = svc.run(reqs, drain_every=len(reqs))
+            return time.perf_counter() - t0, responses
+
+        drain(wave_warm, "w")  # compile/AOT-arm the 8-device geometry
+        t_clean, _ = drain(wave_clean, "c")
+        plan = [inject.Fault(sites.SERVE_DISPATCH, at=0,
+                             kind=taxonomy.HOST_LOST)]
+        with inject.active(*plan):
+            t_fault, responses = drain(wave_fault, "f")
+        not_ok = sum(1 for r in responses if not r.ok)
+        _stage(f"host_loss: clean drain {t_clean:.3f}s, faulted "
+               f"{t_fault:.3f}s, recovery overhead "
+               f"{max(t_fault - t_clean, 0.0):.3f}s")
+        return {
+            "devices_before": ndev,
+            "devices_after": int(eng.mesh.devices.size),
+            "drain_clean_s": round(t_clean, 4),
+            "drain_faulted_s": round(t_fault, 4),
+            "recovery_to_first_answer_s": round(
+                max(t_fault - t_clean, 0.0), 4),
+            "host_loss_recoveries": int(
+                svc.metrics.host_loss_recoveries),
+            "answers_not_ok": not_ok,
+        }
+
+
 def _hbm_high_water():
     """Max per-device peak memory (bytes) the backend reports, or None
     when it reports nothing (CPU: ``memory_stats()`` is None/empty, so
@@ -2160,6 +2333,8 @@ if __name__ == "__main__":
             serve_main()
     elif "multichip" in sys.argv[1:]:
         multichip_main()
+    elif "multihost" in sys.argv[1:]:
+        multihost_main()
     elif "scale_sweep" in sys.argv[1:]:
         scale_sweep_main()
     elif "unlearn" in sys.argv[1:]:

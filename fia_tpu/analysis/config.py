@@ -65,6 +65,14 @@ DISPATCH_PATH_FUNCTIONS = (
     ("fia_tpu/serve/service.py", "_dispatch_misses"),
     ("fia_tpu/serve/service.py", "drain"),
     ("fia_tpu/serve/service.py", "_drain_impl"),
+    # Host-sharded dispatch (docs/design.md §25): the per-host shard
+    # compute and the coordinator's journal merge sit on the same
+    # "queries exist → fused program runs" path, one pod level up; a
+    # per-row transfer inside either would reintroduce the dispatch
+    # wall across EVERY host at once.
+    ("fia_tpu/serve/service.py", "_dispatch_hostshard"),
+    ("fia_tpu/serve/hostshard.py", "dispatch_local_shard"),
+    ("fia_tpu/serve/hostshard.py", "merge_host_shards"),
     # The sharded hot path's one sanctioned cross-device fetch: the
     # masked-gather + psum collective that pulls per-query block rows
     # out of the row-sharded tables (docs/design.md §20). Registered so

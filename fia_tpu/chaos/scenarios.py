@@ -456,7 +456,7 @@ class ServeStreamScenario(Scenario):
         allowed = {
             taxonomy.OOM, taxonomy.HOST_OOM, taxonomy.AMBIGUOUS,
             taxonomy.WORKER, taxonomy.PREEMPTION, taxonomy.NAN,
-            taxonomy.DEADLINE, taxonomy.DEVICE_LOST,
+            taxonomy.DEADLINE, taxonomy.DEVICE_LOST, taxonomy.HOST_LOST,
             admission.REASON_OVERLOAD, admission.REASON_INVALID,
             admission.REASON_DEGRADED,
         }
@@ -712,6 +712,72 @@ class DeviceLossRecoveryScenario(ServeStreamScenario):
                             "bit-identical)",
                         ))
         return failures
+
+
+class HostLossRecoveryScenario(DeviceLossRecoveryScenario):
+    """Kill whole hosts mid-wave; the pod must shrink by hosts and
+    recover.
+
+    The device-loss workload scaled to a pod stand-in: 8 devices under
+    a 4-host virtual overlay (2 devices per host,
+    ``parallel.mesh.virtual_hosts``), with ``host_lost`` faults armed at
+    ``serve.dispatch``. Each loss must trigger the host-granular
+    mesh-shrink recovery (docs/design.md §25): drop the lost host's
+    ENTIRE device group via ``surviving_mesh(..., unnamed="host")``,
+    rebuild, AOT re-arm, re-dispatch — so a benign schedule of up to
+    three host losses (8 → 6 → 4 → 2 devices) sheds nothing and stays
+    bit-identical to the fault-free single-device reference. Oracles
+    are inherited: ``shrunk_mesh_identity`` and
+    ``no_unclassified_errors`` — a host loss that escapes unclassified
+    or perturbs a single served byte is the failure this scenario
+    exists to catch. ``mesh.rebuild_multihost`` is deliberately NOT in
+    any domain for the same armed ⇒ fired reason as ``mesh.rebuild``.
+
+    Degrades to the meshless workload when fewer than 8 devices exist
+    (``mesh_skipped`` event), where ``host_lost`` sheds classified and
+    so moves to the FULL domain only.
+    """
+
+    name = "host_loss_recovery"
+    NDEV = 8
+    NHOSTS = 4
+
+    def __init__(self):
+        super().__init__()
+        # super() built the 8-device mesh (or skipped it) and armed
+        # DEVICE_LOST; re-arm with HOST_LOST — same sites, same caps,
+        # host-granular evidence.
+        if self.mesh is not None:
+            self.benign_domain = {
+                sites.SERVE_DISPATCH: ((taxonomy.HOST_LOST,), 3),
+                sites.SERVE_CACHE_PUBLISH: (_DAMAGE_KINDS, 10),
+            }
+        self.full_domain = {
+            sites.SERVE_DISPATCH: (
+                (taxonomy.WORKER, taxonomy.OOM, taxonomy.DEADLINE,
+                 taxonomy.HOST_LOST), 4),
+            sites.SERVE_CACHE_PUBLISH: (_DAMAGE_KINDS, 1),
+            sites.CHAOS_SCENARIO: ((taxonomy.WORKER,), 1),
+        }
+
+    def run(self, workdir: str, events: list) -> dict:
+        from fia_tpu.parallel import mesh as pmesh
+
+        if self.mesh is None:
+            return super().run(workdir, events)
+        # the virtual-host overlay is scoped to the run so other
+        # scenarios in the same battery see real process indices
+        overlay = {
+            int(d.id): int(d.id) // (self.NDEV // self.NHOSTS)
+            for d in self.mesh.devices.flat
+        }
+        with pmesh.virtual_hosts(overlay):
+            out = super().run(workdir, events)
+            events.append({
+                "event": "hosts_after",
+                "hosts": int(len(pmesh.mesh_hosts(self.engine.mesh))),
+            })
+        return out
 
 
 class FactorBankScenario(Scenario):
@@ -1818,7 +1884,7 @@ class ServeMultitenantScenario(Scenario):
         allowed = {
             taxonomy.OOM, taxonomy.HOST_OOM, taxonomy.AMBIGUOUS,
             taxonomy.WORKER, taxonomy.PREEMPTION, taxonomy.NAN,
-            taxonomy.DEADLINE, taxonomy.DEVICE_LOST,
+            taxonomy.DEADLINE, taxonomy.DEVICE_LOST, taxonomy.HOST_LOST,
             admission.REASON_OVERLOAD, admission.REASON_INVALID,
             admission.REASON_DEGRADED,
         }
@@ -1930,6 +1996,7 @@ def make_scenarios() -> dict:
         ServeStreamScenario.name: ServeStreamScenario,
         ServeStreamMeshScenario.name: ServeStreamMeshScenario,
         DeviceLossRecoveryScenario.name: DeviceLossRecoveryScenario,
+        HostLossRecoveryScenario.name: HostLossRecoveryScenario,
         FactorBankScenario.name: FactorBankScenario,
         UpdateWhileServingScenario.name: UpdateWhileServingScenario,
         UnlearnWhileServingScenario.name: UnlearnWhileServingScenario,

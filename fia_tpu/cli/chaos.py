@@ -39,7 +39,8 @@ from fia_tpu.chaos.scenarios import SCENARIO_NAMES
 # single-device workload rather than failing.
 SMOKE_SCENARIOS = ("selftest", "train_resume", "query_cache",
                    "serve_stream", "serve_stream_mesh",
-                   "device_loss_recovery", "factor_bank",
+                   "device_loss_recovery", "host_loss_recovery",
+                   "factor_bank",
                    "update_while_serving", "unlearn_while_serving",
                    "serve_brownout", "serve_multitenant")
 SMOKE_SEEDS_PER_SCENARIO = 2

@@ -161,9 +161,17 @@ def build_service(args):
         kind = taxonomy.classify(e)
         if kind is None:
             raise
-        print(json.dumps({"event": "serve.construct_failed",
-                          "kind": kind, "error": str(e)}),
-              file=sys.stderr)
+        line = {"event": "serve.construct_failed", "kind": kind,
+                "error": str(e)}
+        # the liveness probe attaches exactly which mesh members failed
+        # (device ids always, whole hosts when an entire process's
+        # devices are dark) — surfaced so the operator knows what to
+        # rebuild around, not just that construction failed
+        if getattr(e, "devices", None):
+            line["devices"] = [int(d) for d in e.devices]
+        if getattr(e, "hosts", None):
+            line["hosts"] = [int(h) for h in e.hosts]
+        print(json.dumps(line), file=sys.stderr)
         raise SystemExit(1)
     return svc, splits
 

@@ -617,10 +617,20 @@ class InfluenceEngine:
         Passing ``mesh=None`` re-homes onto the default single device —
         the last rung before giving up entirely.
         """
+        from fia_tpu.parallel.mesh import mesh_hosts
+
         inject.fire(sites.MESH_REBUILD)
+        nhosts = 0 if mesh is None else len(mesh_hosts(mesh))
+        if nhosts > 1:
+            # Cross-host rebuilds carry extra failure surface (DCN
+            # re-placement against hosts that may themselves be
+            # settling), so they get their own injection site on top of
+            # the generic one.
+            inject.fire(sites.MESH_REBUILD_MULTIHOST)
         obs.REGISTRY.counter("engine.mesh_rebuilds").inc()
         obs.event("mesh.rebuild",
-                  ndev=1 if mesh is None else int(mesh.devices.size))
+                  ndev=1 if mesh is None else int(mesh.devices.size),
+                  nhosts=nhosts)
         self.mesh = mesh
         self._multihost = False
         if mesh is not None:

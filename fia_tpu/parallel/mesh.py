@@ -11,14 +11,99 @@ workload are:
 
 All entry points accept an optional Mesh; everything degrades to single
 device when the mesh is None or trivial.
+
+Hosts: on a real pod every device carries the ``process_index`` of the
+host that owns it, and host loss (all of one process's devices dying at
+once) is a distinct failure granularity from device loss —
+:func:`lost_host_ids` is the liveness probe, :func:`surviving_mesh`
+accepts whole-host drops, and :func:`mesh_fingerprint` keys on the
+host layout so a mesh rebuilt over a different host assignment never
+reuses another topology's executables. CI runs single-process with
+virtual CPU devices, so :func:`virtual_hosts` lets tests overlay a
+device→host map and exercise every host-granularity path without a
+second process.
 """
 
 from __future__ import annotations
+
+from contextlib import contextmanager
 
 import numpy as np
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Armed by virtual_hosts()/set_virtual_hosts(): device id -> host index.
+# None means "trust the backend" (d.process_index). Process-global like
+# the backend topology it stands in for; arm it from the test thread.
+_VIRTUAL_HOSTS: dict[int, int] | None = None
+
+
+def set_virtual_hosts(mapping: dict[int, int] | None) -> None:
+    """Overlay a device-id→host-index map (None restores the backend).
+
+    Single-process CI has every virtual device on process 0, which
+    makes host-granularity code untestable. With a map armed,
+    :func:`host_index` (and everything built on it: host fingerprints,
+    host liveness, host-granular mesh shrinks) sees the overlay
+    topology instead. Devices absent from the map fall back to their
+    real ``process_index``.
+    """
+    global _VIRTUAL_HOSTS
+    _VIRTUAL_HOSTS = None if mapping is None else {
+        int(k): int(v) for k, v in mapping.items()
+    }
+
+
+@contextmanager
+def virtual_hosts(mapping: dict[int, int]):
+    """Scoped :func:`set_virtual_hosts` for tests and chaos scenarios."""
+    global _VIRTUAL_HOSTS
+    prev = _VIRTUAL_HOSTS
+    set_virtual_hosts(mapping)
+    try:
+        yield
+    finally:
+        _VIRTUAL_HOSTS = prev
+
+
+def host_index(device) -> int:
+    """The host (process) index that owns ``device``.
+
+    Honors an armed :func:`virtual_hosts` overlay; otherwise the
+    backend's ``process_index``.
+    """
+    if _VIRTUAL_HOSTS is not None:
+        h = _VIRTUAL_HOSTS.get(int(device.id))
+        if h is not None:
+            return h
+    return int(device.process_index)
+
+
+def mesh_hosts(mesh: Mesh | None) -> tuple[int, ...]:
+    """Sorted distinct host indices a mesh spans (empty for no mesh)."""
+    if mesh is None:
+        return ()
+    return tuple(sorted({host_index(d) for d in mesh.devices.flat}))
+
+
+def init_pod_mesh(
+    axis_names: tuple[str, ...] = ("data",),
+    shape: tuple[int, ...] | None = None,
+    **distributed_kwargs,
+) -> Mesh:
+    """Initialize the multi-host runtime and build a global pod mesh.
+
+    Wraps :func:`fia_tpu.parallel.distributed.initialize` (idempotent;
+    a no-op single-process) and lays *all* global devices — every
+    host's, in backend order — into one mesh. Single-process this is
+    exactly :func:`make_mesh` over the local devices, so callers write
+    one code path for laptop CI and pod serving.
+    """
+    from fia_tpu.parallel import distributed
+
+    distributed.initialize(**distributed_kwargs)
+    return make_mesh(axis_names=axis_names, shape=shape)
 
 
 def make_mesh(
@@ -42,7 +127,13 @@ def mesh_fingerprint(mesh: Mesh | None):
     Keys every compiled-executable cache that must distinguish device
     topologies (the engine's AOT geometry keys, serve-config/engine
     consistency checks): same axis names, same shape, same devices in
-    the same order ⇒ same lowered shardings ⇒ reusable executable.
+    the same order, same device→host assignment ⇒ same lowered
+    shardings ⇒ reusable executable. The host layout is part of the
+    identity because cross-host meshes lower to different collectives
+    (DCN vs ICI links) than single-host ones with identical device ids
+    — and it is stable across process restarts: a restarted coordinator
+    rebuilding the same mesh over the same pod computes the same
+    fingerprint and resumes its journals/AOT caches.
     """
     if mesh is None:
         return None
@@ -50,6 +141,7 @@ def mesh_fingerprint(mesh: Mesh | None):
         tuple(mesh.axis_names),
         tuple(int(mesh.shape[a]) for a in mesh.axis_names),
         tuple(int(d.id) for d in mesh.devices.flat),
+        tuple(host_index(d) for d in mesh.devices.flat),
     )
 
 
@@ -78,18 +170,45 @@ def lost_device_ids(mesh: Mesh | None) -> tuple[int, ...]:
     ))
 
 
-def surviving_mesh(mesh: Mesh, lost_ids=()) -> Mesh | None:
-    """The shrunk mesh after device loss: survivors, original order.
+def lost_host_ids(mesh: Mesh | None) -> tuple[int, ...]:
+    """Hosts *all* of whose mesh devices are dead (sorted).
 
-    ``lost_ids``: device ids known dead (:func:`lost_device_ids`). When
-    empty — a dispatch fault classified ``device_lost`` without naming
-    the culprit, the common case for injected losses and terse backend
-    errors — the LAST mesh device is dropped: deterministic, and the
-    *identity* of the dropped device never matters for results (every
-    mesh size serves bit-identically, docs/design.md §15); only the
-    shrink itself does. Returns ``None`` when no device would survive
-    (or nothing would shrink — a named loss set disjoint from the
-    mesh), so callers shed classified instead of rebuilding in place.
+    The host-granularity liveness probe: a collective timing out says
+    "some peer is gone" without naming it, so recovery asks the backend
+    which devices still answer and promotes a loss to host granularity
+    only when an entire process's devices went dark together. A host
+    with any surviving device is NOT listed — that is device loss, and
+    the finer-grained shrink handles it.
+    """
+    if mesh is None:
+        return ()
+    live = live_device_ids()
+    by_host: dict[int, list[bool]] = {}
+    for d in mesh.devices.flat:
+        by_host.setdefault(host_index(d), []).append(int(d.id) in live)
+    return tuple(sorted(h for h, alive in by_host.items() if not any(alive)))
+
+
+def surviving_mesh(
+    mesh: Mesh, lost_ids=(), lost_hosts=(), unnamed: str = "device"
+) -> Mesh | None:
+    """The shrunk mesh after device or host loss: survivors, original
+    order.
+
+    ``lost_ids``: device ids known dead (:func:`lost_device_ids`).
+    ``lost_hosts``: host indices known dead (:func:`lost_host_ids`) —
+    every device they own is dropped, unioned with ``lost_ids``. When
+    both are empty — a dispatch fault classified ``device_lost`` /
+    ``host_lost`` without naming the culprit, the common case for
+    injected losses and terse backend errors — a deterministic victim
+    is dropped: the LAST mesh device (``unnamed="device"``) or the
+    whole host owning the last mesh device (``unnamed="host"``).
+    Deterministic, and the *identity* of the dropped unit never matters
+    for results (every mesh size serves bit-identically,
+    docs/design.md §15); only the shrink itself does. Returns ``None``
+    when no device would survive (or nothing would shrink — a named
+    loss set disjoint from the mesh), so callers shed classified
+    instead of rebuilding in place.
 
     A 2-D mesh with model parallelism keeps its trailing axis sizes
     when enough survivors remain to fill whole 'model' groups (excess
@@ -99,14 +218,23 @@ def surviving_mesh(mesh: Mesh, lost_ids=()) -> Mesh | None:
     fit one device. Only when survivors cannot fill even one group
     does the mesh collapse to trailing-axis size 1 (the engine's
     ``_sharded_now`` degrades to replicated placement — last resort
-    over dying).
+    over dying). Host drops go through the same group math: losing a
+    host is just losing its devices, one level up.
     """
     devs = list(mesh.devices.flat)
     lost = frozenset(int(i) for i in lost_ids)
+    dead_hosts = frozenset(int(h) for h in lost_hosts)
+    if dead_hosts:
+        lost = lost | frozenset(
+            int(d.id) for d in devs if host_index(d) in dead_hosts
+        )
     if lost:
         keep = [d for d in devs if int(d.id) not in lost]
         if len(keep) == len(devs):
             return None
+    elif unnamed == "host":
+        victim = host_index(devs[-1])
+        keep = [d for d in devs if host_index(d) != victim]
     else:
         keep = devs[:-1]
     if not keep:

@@ -94,6 +94,10 @@ MESSAGES = {
         "UNAVAILABLE: TPU device lost: chip unreachable on the ICI "
         "fabric (injected)"
     ),
+    taxonomy.HOST_LOST: (
+        "DEADLINE_EXCEEDED: collective operation timed out waiting for "
+        "peer task; host unreachable on the DCN (injected)"
+    ),
 }
 
 

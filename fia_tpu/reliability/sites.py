@@ -60,6 +60,10 @@ SERVE_CACHE_PUBLISH = "serve.cache_publish"
 # -- device-loss recovery ----------------------------------------------
 MESH_REBUILD = "mesh.rebuild"
 
+# -- host-loss recovery ------------------------------------------------
+HOST_LOST = "host.lost"
+MESH_REBUILD_MULTIHOST = "mesh.rebuild_multihost"
+
 # -- streaming updates -------------------------------------------------
 STREAM_UPDATE = "stream.update"
 STREAM_SWAP = "stream.swap"
@@ -90,6 +94,8 @@ ALL_SITES = frozenset({
     SERVE_DISPATCH,
     SERVE_CACHE_PUBLISH,
     MESH_REBUILD,
+    HOST_LOST,
+    MESH_REBUILD_MULTIHOST,
     STREAM_UPDATE,
     STREAM_SWAP,
     AUDIT_SWEEP,

@@ -51,6 +51,16 @@ Kinds (the ``FaultKind`` constants):
   same-topology state rebuild. Carries no size evidence and is not
   blindly retriable (the dead device stays dead), so it belongs to
   neither ``TRANSIENT`` nor ``SIZE_EVIDENCE``.
+- ``HOST_LOST`` — an entire pod host is gone: every device behind one
+  process stopped answering at once (a collective timing out against a
+  dead peer, the coordination service declaring a heartbeat missed, a
+  host unreachable on the DCN). Detection is collective timeout plus a
+  liveness probe (``parallel.mesh.lost_host_ids``); recovery is the
+  device-loss mesh shrink one level up — drop the whole host from the
+  mesh, re-shard row-sharded tables over the survivors, re-arm AOT
+  geometries, re-dispatch. Like ``DEVICE_LOST`` it carries no size
+  evidence and is not blindly retriable, so it belongs to neither
+  ``TRANSIENT`` nor ``SIZE_EVIDENCE``.
 
 ``classify`` returns ``None`` for anything unrecognised — callers must
 re-raise those; an unknown failure retried blindly is how wrong answers
@@ -73,6 +83,7 @@ class FaultKind:
     NAN = "nan"
     DEADLINE = "deadline"
     DEVICE_LOST = "device_lost"
+    HOST_LOST = "host_lost"
 
 
 OOM = FaultKind.OOM
@@ -83,6 +94,7 @@ PREEMPTION = FaultKind.PREEMPTION
 NAN = FaultKind.NAN
 DEADLINE = FaultKind.DEADLINE
 DEVICE_LOST = FaultKind.DEVICE_LOST
+HOST_LOST = FaultKind.HOST_LOST
 
 # Kinds whose recovery destroys no information: the same dispatch may
 # legitimately be retried (after a state rebuild for WORKER/PREEMPTION).
@@ -112,6 +124,18 @@ class DeviceLost(RuntimeError):
     """
 
 
+class HostLost(RuntimeError):
+    """A whole pod host is gone (classified as ``HOST_LOST``).
+
+    Raised by our own code when the liveness probe proves that *every*
+    device behind one process is dead (``parallel.mesh.lost_host_ids``),
+    or when a cross-host shard merge times out waiting on a peer's
+    journal. Backend-raised losses arrive as generic RuntimeErrors
+    (collective timeouts, coordination-service heartbeat errors) and
+    classify via the message signatures below instead.
+    """
+
+
 def classify(e: BaseException) -> str | None:
     """Classify a failure for the retry/degradation layers.
 
@@ -125,6 +149,8 @@ def classify(e: BaseException) -> str | None:
         return DEADLINE
     if isinstance(e, NanPayload):
         return NAN
+    if isinstance(e, HostLost):
+        return HOST_LOST
     if isinstance(e, DeviceLost):
         return DEVICE_LOST
     if isinstance(e, MemoryError):
@@ -133,6 +159,23 @@ def classify(e: BaseException) -> str | None:
     if "RESOURCE_EXHAUSTED" in s or "out of memory" in s.lower():
         return OOM
     low = s.lower()
+    if (
+        # a collective stuck against a dead peer is THE multi-host loss
+        # signature: the local devices are healthy, the remote host is
+        # not answering. Checked before the device-lost signatures
+        # because these messages routinely co-mention devices, and a
+        # host loss must drop the whole host from the mesh — shrinking
+        # by one device would leave the dead host's siblings in the
+        # mesh to hang the next collective too.
+        ("collective" in low and ("timed out" in low or "timeout" in low))
+        or ("coordination service" in low and (
+            "unavailable" in low
+            or "disconnect" in low
+            or "heartbeat" in low
+        ))
+        or ("host" in low and "unreachable" in low)
+    ):
+        return HOST_LOST
     if (
         "device lost" in low
         or "lost device" in low

@@ -66,6 +66,12 @@ class AdmissionController:
     tenant exactly like ``class_quotas`` is per class. One noisy
     tenant inside a class can otherwise starve its own class's lane —
     the class quota is blind to who filled it.
+    ``class_deadlines``: per-class deadline defaults in seconds
+    (typically the class SLOs, ``request.CLASS_SLOS``) consulted for
+    requests that carry no deadline of their own, BEFORE the global
+    ``default_deadline_s``. A request's explicit ``deadline_s`` always
+    wins — the SLO is the promise made to a class, not a cap on what
+    one caller may ask for.
     """
 
     def __init__(self, max_queue: int = 256,
@@ -73,9 +79,15 @@ class AdmissionController:
                  num_users: int | None = None,
                  num_items: int | None = None,
                  class_quotas: dict[str, float] | None = None,
-                 tenant_quotas: dict[str, float] | None = None):
+                 tenant_quotas: dict[str, float] | None = None,
+                 class_deadlines: dict[str, float] | None = None):
         self.max_queue = max(int(max_queue), 1)
         self.default_deadline_s = default_deadline_s
+        for cls in (class_deadlines or {}):
+            if cls not in CLASSES:
+                raise ValueError(f"class_deadlines names unknown class "
+                                 f"{cls!r} (know {CLASSES})")
+        self.class_deadlines = dict(class_deadlines or {})
         self.num_users = num_users
         self.num_items = num_items
         quotas = dict(DEFAULT_CLASS_QUOTAS)
@@ -133,6 +145,8 @@ class AdmissionController:
         """An admitted request's queue ticket (absolute deadline on the
         service clock)."""
         budget = req.deadline_s
+        if budget is None:
+            budget = self.class_deadlines.get(req.cls)
         if budget is None:
             budget = self.default_deadline_s
         t_deadline = None if budget is None or budget <= 0 else now + budget

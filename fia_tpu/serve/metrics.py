@@ -33,7 +33,8 @@ bound on the per-row score error. Exact answers log ``false``/null.
      "solve_ms": {"p50": f, "p95": f, "max": f},
      "batches": n, "mean_batch_size": f, "cache": {...},
      "modes": {mode: n}, "mode_transitions": n,
-     "device_loss_recoveries": n, "answered_approx": n,
+     "device_loss_recoveries": n, "host_loss_recoveries": n,
+     "answered_approx": n,
      "classes": {cls: {"requests": n, "ok": n, "rejected": {reason: n},
                        "answered_approx": n, "queue_wait_ms": {...}}}}
 
@@ -77,7 +78,7 @@ SCHEMA = {
         "requests", "ok", "rejected", "tiers", "hot_hit_rate",
         "queue_wait_ms", "solve_ms", "batches", "mean_batch_size",
         "cache", "modes", "mode_transitions", "device_loss_recoveries",
-        "answered_approx", "classes",
+        "host_loss_recoveries", "answered_approx", "classes",
     ),
     # one line per brownout-ladder transition (serve/health.py): the
     # windowed signal values that drove the step, for post-mortems
@@ -140,6 +141,7 @@ class ServeMetrics:
         self.batch_sizes: list[int] = []
         self.mode_transitions = 0
         self.device_loss_recoveries = 0
+        self.host_loss_recoveries = 0
         self.answered_approx = 0
         self.err_bounds: list[float] = []  # stamped bounds, ok+approx
         # per-class accounting (multi-tenant rollup "classes" block):
@@ -243,6 +245,12 @@ class ServeMetrics:
         its own — the ``mesh.rebuild`` site and the rollup carry it)."""
         self.device_loss_recoveries += 1
 
+    def record_host_loss_recovery(self) -> None:
+        """Count one completed host-drop mesh-shrink recovery (no event
+        line of its own — the ``host.lost`` / ``mesh.rebuild_multihost``
+        sites and the rollup carry it)."""
+        self.host_loss_recoveries += 1
+
     def record_update(self, **fields) -> None:
         """One ``stream.update`` line (an apply_updates attempt)."""
         self.log.log("stream.update", **fields)
@@ -282,6 +290,7 @@ class ServeMetrics:
             "modes": dict(self.by_mode),
             "mode_transitions": self.mode_transitions,
             "device_loss_recoveries": self.device_loss_recoveries,
+            "host_loss_recoveries": self.host_loss_recoveries,
             "answered_approx": self.answered_approx,
             # per-class lanes: the same accounting identity holds per
             # class (requests == ok + Σ rejected within each lane)

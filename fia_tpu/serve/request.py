@@ -41,6 +41,18 @@ CLASS_SCAVENGER = "scavenger"
 CLASSES = (CLASS_INTERACTIVE, CLASS_BATCH, CLASS_SCAVENGER)
 DEFAULT_CLASS = CLASS_BATCH
 
+# Per-class latency SLOs in seconds — the published service objectives
+# each priority class is sold under. `ServeConfig.class_deadlines=True`
+# adopts these as per-class deadline defaults for requests that carry
+# none of their own, and derives `deadline_slack_s` (the urgent-lane
+# promotion threshold) from the tightest class SLO so the dispatcher's
+# notion of "about to miss" tracks the strictest promise actually made.
+CLASS_SLOS = {
+    CLASS_INTERACTIVE: 0.5,
+    CLASS_BATCH: 10.0,
+    CLASS_SCAVENGER: 60.0,
+}
+
 
 @dataclass
 class Request:

@@ -73,6 +73,13 @@ from fia_tpu.serve.request import (
 )
 from fia_tpu.serve.scheduler import FairScheduler, MicroBatcher
 
+# Failure kinds whose recovery is a topology shrink (rebuild the mesh
+# over survivors) rather than a same-topology retry ladder: device loss
+# drops one device, host loss drops every device behind a dead process.
+# The dispatch paths treat them identically up to which shrink runs —
+# see _recover_topology.
+_TOPOLOGY_KINDS = (taxonomy.DEVICE_LOST, taxonomy.HOST_LOST)
+
 
 @dataclass
 class ServeConfig:
@@ -128,7 +135,31 @@ class ServeConfig:
     # is at or under this slack promotes its batch to the front of a
     # multi-class plan. None disables the promotion (single-class
     # plans are never reordered — that order is the pinned contract).
+    # When class_deadlines is active and this is None, the slack is
+    # derived from the tightest class SLO (see class_deadlines).
     deadline_slack_s: float | None = None
+    # SLO-derived per-class deadline defaults. True adopts
+    # request.CLASS_SLOS verbatim; a dict merges over it (values in
+    # seconds); None/False disables (requests without deadlines keep
+    # default_deadline_s, the pre-SLO behaviour). When active, a
+    # request carrying no deadline of its own is stamped its class's
+    # SLO at admission, and deadline_slack_s (if unset) defaults to a
+    # quarter of the tightest configured SLO — the dispatcher's
+    # "about to miss" horizon tracks the strictest promise made.
+    class_deadlines: dict | bool | None = None
+    # Host-sharded dispatch (docs/design.md §25): a (host, n_hosts,
+    # journal_dir) triple naming this process's shard of the pod's
+    # miss-dispatch work. Each host computes a contiguous row-slice of
+    # every drain's coalesced dispatch order, journals it durably
+    # (reliability/artifacts.py), and the coordinator (host 0) merges
+    # the shard journals — zero hot-path collectives, and a coordinator
+    # restart resumes from the journals instead of recomputing. None =
+    # single-host dispatch (every prior behaviour unchanged).
+    host_role: tuple | None = None
+    # Merge budget for peer shard journals (seconds): a peer whose
+    # journal never appears within this window is a *proved* host loss
+    # (classified ``host_lost``), and the survivors adopt its shard.
+    host_merge_timeout_s: float = 60.0
 
 
 def _approx_extra(res, row: int) -> dict:
@@ -140,6 +171,25 @@ def _approx_extra(res, row: int) -> dict:
     if not getattr(res, "approx", False) or res.err_bound is None:
         return {}
     return {"approx": True, "err_bound": float(res.err_bound[row])}
+
+
+class _MergedRows:
+    """Rows ``[base, base + n)`` of a merged host-shard result,
+    presented through the InfluenceResult row accessors
+    ``_bank_batch`` consumes (``scores_of`` / ``counts`` / ``ihvp`` /
+    ``test_grad``)."""
+
+    def __init__(self, merged: dict, base: int, n: int):
+        self._scores = merged["scores"]
+        self._offsets = merged["offsets"]
+        self._base = int(base)
+        self.counts = merged["counts"][base:base + n]
+        self.ihvp = merged["ihvp"][base:base + n]
+        self.test_grad = merged["test_grad"][base:base + n]
+
+    def scores_of(self, row: int):
+        r = self._base + int(row)
+        return self._scores[self._offsets[r]:self._offsets[r + 1]]
 
 
 def _resolve_mesh(mesh):
@@ -183,6 +233,10 @@ class InfluenceService:
         self.config = config or ServeConfig()
         # a policy.Clock (e.g. VirtualClock) normalises to its reader
         self.clock = getattr(clock, "monotonic", clock)
+        # keep the full Clock object (monotonic + sleep) when one was
+        # passed: the host-shard merge SPENDS time waiting on peers'
+        # journals, and virtual-time tests need that wait to be virtual
+        self._clock_obj = clock if hasattr(clock, "monotonic") else None
         self.cache = HotBlockCache(self.config.cache_entries,
                                    self.config.cache_bytes)
         self.metrics = ServeMetrics(self.config.metrics_path)
@@ -199,21 +253,44 @@ class InfluenceService:
         if self.mesh is not None:
             from fia_tpu.parallel.mesh import (
                 lost_device_ids,
+                lost_host_ids,
                 mesh_fingerprint,
             )
 
             # liveness first: a configured mesh referencing dead device
             # ids must fail construction with a CLASSIFIED error (the
             # operator restarted onto a shrunk slice), not surface as a
-            # backend RuntimeError at the first dispatch
+            # backend RuntimeError at the first dispatch. The error
+            # names exactly which probe members failed — device ids
+            # always, whole hosts when every device behind a process is
+            # dark — and carries them as attributes so the CLI's
+            # serve.construct_failed line is actionable, not a bare
+            # "construction failed".
             dead = lost_device_ids(self.mesh)
             if dead:
-                raise taxonomy.DeviceLost(
-                    f"ServeConfig.mesh references device id(s) "
-                    f"{list(dead)} the backend cannot see; rebuild the "
-                    "mesh over live devices (parallel.mesh.make_mesh / "
-                    "surviving_mesh) before constructing the service"
+                # host granularity only means something on a mesh that
+                # actually spans hosts — a single-host mesh with dead
+                # devices is device loss, same as always
+                from fia_tpu.parallel.mesh import mesh_hosts
+
+                dead_hosts = (lost_host_ids(self.mesh)
+                              if len(mesh_hosts(self.mesh)) > 1 else ())
+                host_note = (
+                    f" (host(s) {list(dead_hosts)} lost entirely)"
+                    if dead_hosts else ""
                 )
+                err = taxonomy.HostLost if dead_hosts else \
+                    taxonomy.DeviceLost
+                e = err(
+                    f"ServeConfig.mesh references device id(s) "
+                    f"{list(dead)} the backend cannot see{host_note}; "
+                    "rebuild the mesh over live devices "
+                    "(parallel.mesh.make_mesh / surviving_mesh) before "
+                    "constructing the service"
+                )
+                e.devices = list(dead)
+                e.hosts = list(dead_hosts)
+                raise e
             if mesh_fingerprint(getattr(eng, "mesh", None)) != \
                     mesh_fingerprint(self.mesh):
                 raise ValueError(
@@ -223,6 +300,35 @@ class InfluenceService:
                     "from_model, which builds its engines over it"
                 )
         self.health = HealthController(self.config.health)
+        # SLO-derived deadline defaults: resolve the class_deadlines
+        # knob (True = the published CLASS_SLOS; dict = overrides
+        # merged over them), and derive the urgent-lane slack from the
+        # tightest SLO when the operator did not pin one explicitly.
+        cds = self.config.class_deadlines
+        if cds:
+            from fia_tpu.serve.request import CLASS_SLOS
+
+            resolved = dict(CLASS_SLOS)
+            if isinstance(cds, dict):
+                resolved.update({k: float(v) for k, v in cds.items()})
+            self.class_deadlines = resolved
+        else:
+            self.class_deadlines = None
+        self.deadline_slack_s = self.config.deadline_slack_s
+        if self.deadline_slack_s is None and self.class_deadlines:
+            self.deadline_slack_s = 0.25 * min(
+                self.class_deadlines.values()
+            )
+        # Host-sharded dispatch role: (host, n_hosts, journal_dir)
+        self.host_role = None
+        if self.config.host_role is not None:
+            h, n, jdir = self.config.host_role
+            h, n = int(h), int(n)
+            if not 0 <= h < n:
+                raise ValueError(
+                    f"host_role host index {h} out of range for "
+                    f"{n} host(s)")
+            self.host_role = (h, n, str(jdir))
         self.admission = AdmissionController(
             max_queue=self.config.max_queue,
             default_deadline_s=self.config.default_deadline_s,
@@ -230,6 +336,7 @@ class InfluenceService:
             num_items=eng.model.num_items,
             class_quotas=self.config.class_quotas,
             tenant_quotas=self.config.tenant_quotas,
+            class_deadlines=self.class_deadlines,
         )
         self._queue: list[Ticket] = []
         # queued tickets per class / per tenant (admission quota
@@ -640,7 +747,7 @@ class InfluenceService:
         waiter's remaining deadline budget is inside the configured
         slack (None when deadline promotion is disabled)."""
         classes = [self._key_class(misses[k]) for k in keys]
-        slack = self.config.deadline_slack_s
+        slack = self.deadline_slack_s
         if slack is None:
             return classes, None
         now = self.clock()
@@ -658,6 +765,10 @@ class InfluenceService:
         counts = eng.index.counts_batch(points)
         classes, urgent = self._miss_lanes(misses, keys)
         plan = self.scheduler.plan(counts, classes, urgent)
+        if self.host_role is not None:
+            self._dispatch_hostshard(eng, fp, misses, responses, keys,
+                                     counts, points, plan)
+            return
         if not self._overlap_eligible(eng):
             for batch in plan:
                 self._dispatch_one(eng, fp, misses, responses, keys,
@@ -687,15 +798,16 @@ class InfluenceService:
                     kind = taxonomy.classify(e)
                     if kind is None:
                         raise
-                    if kind == taxonomy.DEVICE_LOST:
-                        # a lost device poisons the in-flight handles
-                        # too: shrink the mesh, then re-dispatch this
-                        # batch, the in-flight ones, and the remainder
-                        # through the guarded path on the survivors —
-                        # nothing sheds, the stream completes
-                        # bit-identically (docs/design.md §18). Only if
-                        # no shrink is possible does this batch shed.
-                        if self._recover_device_loss(eng, [
+                    if kind in _TOPOLOGY_KINDS:
+                        # a lost device/host poisons the in-flight
+                        # handles too: shrink the mesh, then re-dispatch
+                        # this batch, the in-flight ones, and the
+                        # remainder through the guarded path on the
+                        # survivors — nothing sheds, the stream
+                        # completes bit-identically (docs/design.md
+                        # §18). Only if no shrink is possible does this
+                        # batch shed.
+                        if self._recover_topology(kind, eng, [
                             points[b] for (b, _, _, _) in inflight
                         ] + [bpts] + [points[b] for b in plan[bi:]]):
                             retry = [(b, b_bid)
@@ -720,12 +832,12 @@ class InfluenceService:
                     kind = taxonomy.classify(e)
                     if kind is None:
                         raise
-                    if kind == taxonomy.DEVICE_LOST:
+                    if kind in _TOPOLOGY_KINDS:
                         # best-effort shrink before rerouting: on
                         # success the guarded path below re-dispatches
                         # everything on the surviving mesh; on failure
                         # it sheds classified, batch by batch
-                        self._recover_device_loss(eng, [
+                        self._recover_topology(kind, eng, [
                             points[b] for (b, _, _, _) in inflight
                         ] + [bpts] + [points[b] for b in plan[bi:]])
                     # A real dispatch-time device fault poisons the
@@ -773,8 +885,8 @@ class InfluenceService:
                 # instead of shedding (its inputs are host-side; only
                 # the dead device's output buffers were lost).
                 recovered = (
-                    kind == taxonomy.DEVICE_LOST
-                    and self._recover_device_loss(eng, [
+                    kind in _TOPOLOGY_KINDS
+                    and self._recover_topology(kind, eng, [
                         points[batch]
                     ] + [points[b] for (b, _, _, _) in inflight]
                         + [points[b] for b in plan[bi:]])
@@ -815,13 +927,13 @@ class InfluenceService:
             kind = taxonomy.classify(e)
             if kind is None:
                 raise
-            if kind == taxonomy.DEVICE_LOST and self._recover_device_loss(
-                eng, [points[batch]]
+            if kind in _TOPOLOGY_KINDS and self._recover_topology(
+                kind, eng, [points[batch]]
             ):
                 # shrink succeeded: this very batch re-dispatches on the
                 # surviving mesh (recursion is bounded — every recovery
-                # drops a device, and with none left to drop the shrink
-                # fails and the batch sheds classified below)
+                # drops a device/host, and with none left to drop the
+                # shrink fails and the batch sheds classified below)
                 self._dispatch_one(eng, fp, misses, responses, keys,
                                    counts, points, batch, bid=bid)
                 return
@@ -887,6 +999,121 @@ class InfluenceService:
                     t, entry, tier, now, eng, solve_s=dt,
                     batch_id=bid, batch_size=len(batch),
                 )
+
+    # -- host-sharded dispatch (docs/design.md §25) ------------------------
+    def _dispatch_hostshard(self, eng, fp, misses, responses, keys,
+                            counts, points, plan) -> None:
+        """One drain's miss dispatch split across pod hosts by journal.
+
+        Every host runs this same code over the same coalesced plan:
+        compute OWN contiguous batch-aligned shard of the dispatch
+        order through the engine (``hostshard.dispatch_local_shard`` —
+        skipped entirely when a verified journal for it already exists,
+        the restart-resume path), then merge every host's journal back
+        into dispatch order (``hostshard.merge_host_shards`` — pure
+        journal reads, zero hot-path collectives). Shards are
+        batch-boundary-aligned slices of the single-process order, so
+        the merged results are bitwise the single-host stream
+        (``scripts/multihost_smoke.sh`` pins this).
+
+        A peer whose journal never lands inside
+        ``host_merge_timeout_s`` is a proved ``host_lost``: the
+        survivors adopt the dead hosts' shards (recompute them locally
+        from the same plan — the journals make the adoption idempotent)
+        and the drain still answers every request. Only when adoption
+        itself fails classified does the drain shed, batch by batch,
+        with the taxonomy kind.
+        """
+        from fia_tpu.serve import hostshard
+
+        host, nhosts, jdir = self.host_role
+        order = [int(j) for batch in plan for j in batch]
+        opts = points[order]
+        tag = f"drain{self._drain_seq}"
+        mb = int(self.config.max_batch)
+        t0 = self.clock()
+        # batch ids allocated up front in plan order, so ids and the
+        # dispatch log match the single-host stream
+        bids = []
+        for batch in plan:
+            bid = self._batch_id
+            self._batch_id += 1
+            self.dispatch_log.append((bid, np.array(points[batch])))
+            bids.append(bid)
+        try:
+            inject.fire(sites.SERVE_DISPATCH)
+            with obs.span("serve.hostshard_drain", host=int(host),
+                          nhosts=int(nhosts), rows=len(order)):
+                hostshard.dispatch_local_shard(
+                    eng, opts, host=host, nhosts=nhosts,
+                    journal_dir=jdir, tag=tag, engine_fp=fp,
+                    max_batch=mb,
+                )
+                merged = hostshard.merge_host_shards(
+                    jdir, tag, nhosts, opts, engine_fp=fp, max_batch=mb,
+                    timeout_s=float(self.config.host_merge_timeout_s),
+                    clock=self._merge_clock(),
+                )
+        except Exception as e:
+            kind = taxonomy.classify(e)
+            if kind is None:
+                raise
+            merged = None
+            if kind == taxonomy.HOST_LOST:
+                merged = self._adopt_missing_shards(eng, fp, opts, tag)
+            if merged is None:
+                for bi, batch in enumerate(plan):
+                    self._shed_batch(misses, responses, keys, counts,
+                                     batch, bids[bi], kind, t0)
+                return
+        base = 0
+        for bi, batch in enumerate(plan):
+            view = _MergedRows(merged, base, len(batch))
+            self._bank_batch(eng, fp, misses, responses, keys, counts,
+                             batch, bids[bi], view, t0)
+            base += len(batch)
+
+    def _merge_clock(self):
+        from fia_tpu.reliability import policy as rpolicy
+
+        return self._clock_obj if self._clock_obj is not None \
+            else rpolicy.WALL
+
+    def _adopt_missing_shards(self, eng, fp, opts, tag):
+        """Survivor-side recovery for the journal transport: recompute
+        every shard whose journal is missing (``dispatch_local_shard``
+        verifies and skips the ones already on disk — including our
+        own) and re-merge with a zero wait. Returns the merged arrays,
+        or None when the adoption itself failed classified (the caller
+        sheds)."""
+        from fia_tpu.serve import hostshard
+
+        host, nhosts, jdir = self.host_role
+        mb = int(self.config.max_batch)
+        try:
+            inject.fire(sites.HOST_LOST)
+            seed = (f"host-loss-"
+                    f"{self.metrics.host_loss_recoveries}")
+            with obs.span("serve.host_loss_recovery", trace_seed=seed,
+                          host=int(host), nhosts=int(nhosts),
+                          transport="journal"):
+                for h in range(nhosts):
+                    hostshard.dispatch_local_shard(
+                        eng, opts, host=h, nhosts=nhosts,
+                        journal_dir=jdir, tag=tag, engine_fp=fp,
+                        max_batch=mb,
+                    )
+                merged = hostshard.merge_host_shards(
+                    jdir, tag, nhosts, opts, engine_fp=fp, max_batch=mb,
+                    timeout_s=0.0, clock=self._merge_clock(),
+                )
+        except Exception as e:
+            if taxonomy.classify(e) is None:
+                raise
+            return None
+        self.metrics.record_host_loss_recovery()
+        obs.REGISTRY.counter("serve.host_loss_recoveries").inc()
+        return merged
 
     def _dispatch_approx(self, eng, fp, misses, responses) -> None:
         """Serve brownout misses from the certified ``sampled`` rung.
@@ -1053,6 +1280,73 @@ class InfluenceService:
         self.metrics.record_device_loss_recovery()
         obs.REGISTRY.counter("serve.device_loss_recoveries").inc()
         return True
+
+    # -- host-loss recovery (docs/design.md §25) ---------------------------
+    def _recover_host_loss(self, eng, pending_points) -> bool:
+        """Shrink the serving mesh over the surviving *hosts*.
+
+        The ``host_lost`` analogue of :meth:`_recover_device_loss`, one
+        granularity up: a collective timing out (or a coordination-
+        service heartbeat error) says some peer process is gone, so the
+        liveness probe asks which mesh hosts lost every device
+        (:func:`~fia_tpu.parallel.mesh.lost_host_ids`; an injected loss
+        names none, so the deterministic last-host drop applies), drops
+        those hosts wholesale, re-homes the engine on the survivors —
+        which re-shards row-sharded tables onto them and re-fires the
+        ``mesh.rebuild_multihost`` site when the result still spans
+        hosts — and AOT re-arms the pending dispatch geometries.
+        Results are unchanged by construction: every mesh size runs the
+        exact single-device program per shard (docs/design.md §15), so
+        the survivors' answers byte-match a fault-free smaller-pod run.
+
+        Returns False — caller sheds classified — when there is no mesh
+        to shrink, no host would survive the drop, or the rebuild
+        itself failed with a classified fault.
+        """
+        from fia_tpu.parallel import mesh as pmesh
+
+        cur = getattr(eng, "mesh", None)
+        if cur is None:
+            return False
+        new = pmesh.surviving_mesh(
+            cur,
+            lost_ids=pmesh.lost_device_ids(cur),
+            lost_hosts=pmesh.lost_host_ids(cur),
+            unnamed="host",
+        )
+        if new is None:
+            return False
+        try:
+            inject.fire(sites.HOST_LOST)
+            seed = (f"host-loss-"
+                    f"{self.metrics.host_loss_recoveries}")
+            with obs.span("serve.host_loss_recovery",
+                          trace_seed=seed,
+                          ndev=int(new.devices.size),
+                          nhosts=len(pmesh.mesh_hosts(new))) as sp:
+                eng.rebuild_mesh(new)
+                if (eng.impl in ("auto", "flat") and eng._flat_eligible()
+                        and not eng._wide_block_cap()
+                        and not eng._multihost):
+                    geoms = {tuple(eng.flat_geometry(np.asarray(p)))
+                             for p in pending_points if len(p)}
+                    eng.precompile_flat(sorted(geoms))
+                    sp.set(rearmed=len(geoms))
+        except Exception as e:
+            if taxonomy.classify(e) is None:
+                raise
+            return False
+        self.mesh = new
+        self.metrics.record_host_loss_recovery()
+        obs.REGISTRY.counter("serve.host_loss_recoveries").inc()
+        return True
+
+    def _recover_topology(self, kind, eng, pending_points) -> bool:
+        """Route a topology-loss kind to its shrink: ``host_lost``
+        drops whole hosts, ``device_lost`` drops one device."""
+        if kind == taxonomy.HOST_LOST:
+            return self._recover_host_loss(eng, pending_points)
+        return self._recover_device_loss(eng, pending_points)
 
     def _disk_dir(self, eng) -> str | None:
         if not self.config.disk_cache or not eng.cache_dir:

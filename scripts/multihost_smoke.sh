@@ -1,0 +1,169 @@
+#!/usr/bin/env bash
+# Multi-host smoke: the journal-transport host-sharded dispatch path
+# (fia_tpu/serve/hostshard.py, docs/design.md §25) across two REAL
+# OS processes on CPU, asserting:
+#   - each host process computes its shard with ZERO steady-state
+#     backend compiles (utils/compilemon: recompute after warm adds
+#     nothing) and resumes from its own verified journal without
+#     recompute
+#   - a separate coordinator-only process, holding NO engine and no
+#     live connection to either host, merges the journals and the
+#     result is np.array_equal to a single-process reference run —
+#     cross-host bitwise identity, the §25 contract
+#   - the host_loss_recovery chaos scenario passes under seeded benign
+#     schedules (host losses shrink the pod by whole hosts and stay
+#     bit-identical to a fault-free reference)
+#
+#   bash scripts/multihost_smoke.sh    (or: make multihost-smoke)
+#
+# Budget: <90s on CPU — tiny untrained MF, 2 hosts, 24 queries. The
+# journals land in a throwaway tmpdir so repeated runs stay hermetic.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+DIR=$(mktemp -d /tmp/fia_multihost_smoke.XXXXXX)
+trap 'rm -rf "$DIR"' EXIT
+
+# the role helper lives in the tmpdir; the repo root must stay on the
+# import path for it
+export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
+
+HELPER="$DIR/roles.py"
+cat > "$HELPER" <<'EOF'
+"""Multi-host smoke roles: ref | host <h> | merge (one process each)."""
+import hashlib
+import sys
+
+import numpy as np
+
+U, I, K, WD, DAMP = 30, 20, 4, 1e-2, 1e-3
+NHOSTS, MAX_BATCH, NQUERIES = 2, 8, 24
+TAG = "smoke"
+
+
+def build_engine():
+    """Deterministic tiny engine — identical bytes in every process."""
+    import jax
+
+    from fia_tpu.data.dataset import RatingDataset
+    from fia_tpu.influence.engine import InfluenceEngine
+    from fia_tpu.models import MF
+
+    rng = np.random.default_rng(0)
+    n = 300
+    x = np.stack([rng.integers(0, U, n), rng.integers(0, I, n)], 1)
+    y = rng.normal(size=n)
+    model = MF(U, I, K, WD)
+    params = model.init_params(jax.random.PRNGKey(0))
+    eng = InfluenceEngine(model, params, RatingDataset(x, y),
+                          damping=DAMP, model_name="multihost-smoke",
+                          kernel="xla_analytic")
+    return eng, params
+
+
+def engine_fp(params):
+    h = hashlib.sha1()
+    for name in sorted(params):
+        h.update(name.encode())
+        h.update(np.ascontiguousarray(np.asarray(params[name])).tobytes())
+    return h.hexdigest()
+
+
+def points():
+    rng = np.random.default_rng(7)
+    flat = rng.choice(U * I, size=NQUERIES, replace=False)
+    return np.stack([flat // I, flat % I], 1).astype(np.int64)
+
+
+def main():
+    role, jdir = sys.argv[1], sys.argv[2]
+    pts = points()
+    if role == "ref":
+        from fia_tpu.serve import hostshard
+
+        eng, params = build_engine()
+        ref = hostshard._pack_result(
+            eng.query_many(pts, batch_queries=MAX_BATCH))
+        np.savez(f"{jdir}/reference.npz", **ref)
+        print(f"[ref] single-process reference: "
+              f"{len(ref['counts'])} rows, {ref['scores'].size} scores")
+    elif role == "host":
+        from fia_tpu.serve import hostshard
+        from fia_tpu.utils import compilemon
+
+        h = int(sys.argv[3])
+        eng, params = build_engine()
+        fp = engine_fp(params)
+        hostshard.dispatch_local_shard(
+            eng, pts, host=h, nhosts=NHOSTS, journal_dir=jdir,
+            tag=TAG, engine_fp=fp, max_batch=MAX_BATCH)
+        # steady state: recomputing the warm shard compiles NOTHING
+        start, stop = hostshard.shard_rows(
+            len(pts), NHOSTS, align=MAX_BATCH)[h]
+        c0 = compilemon.count()
+        eng.query_many(pts[start:stop], batch_queries=MAX_BATCH)
+        dc = compilemon.count() - c0
+        assert dc == 0, f"host {h}: {dc} steady-state compiles"
+        # restart resumption: a second dispatch is a verified-journal
+        # skip (and therefore also compiles nothing)
+        hostshard.dispatch_local_shard(
+            eng, pts, host=h, nhosts=NHOSTS, journal_dir=jdir,
+            tag=TAG, engine_fp=fp, max_batch=MAX_BATCH)
+        assert compilemon.count() == c0, f"host {h}: resume recompiled"
+        print(f"[host {h}] shard journaled, 0 steady-state compiles, "
+              "resume verified")
+    elif role == "merge":
+        # coordinator: NO engine is built here — the merge must work
+        # from journal bytes alone (that is what makes coordinator
+        # restart and host-loss adoption possible)
+        from fia_tpu.serve import hostshard
+
+        import jax  # engine_fp needs params; rebuild ONLY the params
+        from fia_tpu.models import MF
+
+        params = MF(U, I, K, WD).init_params(jax.random.PRNGKey(0))
+        merged = hostshard.merge_host_shards(
+            jdir, TAG, NHOSTS, pts, engine_fp=engine_fp(params),
+            max_batch=MAX_BATCH, timeout_s=30.0)
+        ref = np.load(f"{jdir}/reference.npz")
+        for key in ("scores", "counts", "ihvp", "test_grad"):
+            assert np.array_equal(np.asarray(merged[key]),
+                                  np.asarray(ref[key])), (
+                f"cross-host merge diverges from single-process "
+                f"reference on {key!r}")
+        print(f"[merge] {NHOSTS}-host merge bitwise identical to "
+              "single-process reference "
+              f"({merged['scores'].size} scores, "
+              f"{len(merged['counts'])} rows)")
+    else:
+        raise SystemExit(f"unknown role {role!r}")
+
+
+main()
+EOF
+
+# Phase A: fault-free single-process reference.
+JAX_PLATFORMS=cpu timeout -k 10 120 python "$HELPER" ref "$DIR"
+
+# Phase B: two CONCURRENT host processes, each computing + journaling
+# its own shard of the same dispatch order (no coordination channel
+# between them — the journal dir is the only shared state).
+JAX_PLATFORMS=cpu timeout -k 10 120 python "$HELPER" host "$DIR" 0 &
+H0=$!
+JAX_PLATFORMS=cpu timeout -k 10 120 python "$HELPER" host "$DIR" 1 &
+H1=$!
+wait "$H0"
+wait "$H1"
+
+# Phase C: coordinator-only process (no engine) merges from journals.
+JAX_PLATFORMS=cpu timeout -k 10 120 python "$HELPER" merge "$DIR"
+
+# Phase D: host-loss recovery drill — seeded benign host_lost
+# schedules against the 4-virtual-host pod stand-in.
+if [[ "${XLA_FLAGS:-}" != *xla_force_host_platform_device_count* ]]; then
+  export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
+fi
+JAX_PLATFORMS=cpu timeout -k 10 300 python -m fia_tpu.cli.chaos \
+  --smoke --scenario host_loss_recovery --workdir "$DIR/chaos"
+
+echo "multihost-smoke PASS"

@@ -91,6 +91,13 @@ bash scripts/obs_smoke.sh || {
 # exactly the ROADMAP.md pytest command, the smoke just surfaces
 # serving regressions in the same log.
 bash scripts/serve_smoke.sh || echo "serve-smoke FAILED (non-fatal here; run make serve-smoke)"
+# Multihost smoke, NON-fatal (warn-first; promote to FATAL once green
+# across a few PRs, the same path multichip/scale smokes took): the
+# journal-transport host-sharded dispatch across two OS processes —
+# cross-host bitwise identity vs single-process, zero steady-state
+# compiles per host, host_loss_recovery chaos drill (docs/design.md
+# §25).
+bash scripts/multihost_smoke.sh || echo "multihost-smoke FAILED (non-fatal here; run make multihost-smoke)"
 # Scale smoke, FATAL (green since PR 14): row-sharded tables
 # bit-identical to replicated at the 100k tier + per-device table
 # residency shrinking with model_parallel (docs/design.md §20).

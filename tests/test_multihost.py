@@ -1,0 +1,478 @@
+"""Multi-host pod serving (docs/design.md §25): host-loss survival and
+journal-transport host-sharded dispatch.
+
+- ``host_lost`` is its own taxonomy kind at a coarser granularity than
+  ``device_lost``: recovery drops a whole host's device group from the
+  mesh (``surviving_mesh(..., unnamed="host")``), and the recovered
+  stream must stay BIT-identical to a fault-free run;
+- the host-shard dispatch path coordinates across hosts purely through
+  verified journals — zero hot-path collectives — so shards resume
+  after restarts, a missing peer is a classified ``host_lost`` timeout
+  (never a hang), and the coordinator can adopt a dead host's rows;
+- ``mesh_fingerprint`` keys on the device→host layout and is stable
+  across rebuilds of the same topology, which is what lets a restarted
+  coordinator reuse its journals and AOT caches.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from fia_tpu.data.dataset import RatingDataset
+from fia_tpu.influence.engine import InfluenceEngine
+from fia_tpu.models import MF
+from fia_tpu.parallel import mesh as pmesh
+from fia_tpu.reliability import inject, policy as rpolicy, taxonomy
+from fia_tpu.serve import InfluenceService, Request, ServeConfig
+from fia_tpu.serve import hostshard
+from fia_tpu.serve.admission import AdmissionController
+from fia_tpu.serve.request import CLASS_SLOS
+
+U, I, K = 30, 20, 4
+WD = 1e-2
+DAMP = 1e-3
+
+needs_mesh = pytest.mark.skipif(
+    jax.device_count() < 4, reason="needs >=4 (virtual) devices"
+)
+needs_pod = pytest.mark.skipif(
+    jax.device_count() < 8, reason="needs >=8 (virtual) devices"
+)
+
+
+def _setup(seed=0, n=400):
+    rng = np.random.default_rng(seed)
+    x = np.stack(
+        [rng.integers(0, U, n), rng.integers(0, I, n)], axis=1
+    ).astype(np.int32)
+    y = rng.integers(1, 6, n).astype(np.float32)
+    train = RatingDataset(x, y)
+    model = MF(U, I, K, WD)
+    params = model.init_params(jax.random.PRNGKey(seed))
+    return model, params, train
+
+
+def _engine(model, params, train, **kw):
+    kw.setdefault("damping", DAMP)
+    kw.setdefault("solver", "direct")
+    return InfluenceEngine(model, params, train, **kw)
+
+
+def _service(engine, **cfg):
+    cfg.setdefault("disk_cache", False)
+    clock = cfg.pop("clock", None)
+    kw = {"clock": clock} if clock is not None else {}
+    return InfluenceService(engine=engine, config=ServeConfig(**cfg), **kw)
+
+
+def _unique_points(train, n):
+    uniq = np.unique(train.x, axis=0)
+    assert len(uniq) >= n
+    return uniq[:n].astype(np.int64)
+
+
+def _requests(pts):
+    return [Request(int(u), int(i), id=f"q{n}")
+            for n, (u, i) in enumerate(pts)]
+
+
+def _two_host_overlay(mesh):
+    """First half of the mesh devices on host 0, second half on 1."""
+    devs = [int(d.id) for d in mesh.devices.flat]
+    half = len(devs) // 2
+    return {d: (0 if k < half else 1) for k, d in enumerate(devs)}
+
+
+class TestHostLostTaxonomy:
+    def test_exception_type_classifies(self):
+        assert taxonomy.classify(
+            taxonomy.HostLost("host 2 gone")) == taxonomy.HOST_LOST
+
+    @pytest.mark.parametrize("msg", [
+        "DEADLINE_EXCEEDED: collective operation timed out waiting "
+        "for peer task",
+        "coordination service reports task unavailable: missed "
+        "heartbeat from worker 3",
+        "UNAVAILABLE: host worker-2 unreachable on the DCN",
+    ])
+    def test_message_signatures(self, msg):
+        assert taxonomy.classify(RuntimeError(msg)) == taxonomy.HOST_LOST
+
+    def test_injected_message_classifies(self):
+        # the injection harness must produce the same classification a
+        # real pod failure would
+        assert taxonomy.classify(RuntimeError(
+            inject.MESSAGES[taxonomy.HOST_LOST])) == taxonomy.HOST_LOST
+
+    def test_device_signatures_stay_device_lost(self):
+        # host-loss evidence mentions devices too; plain device-loss
+        # messages must not get promoted to host granularity
+        assert taxonomy.classify(RuntimeError(
+            "device tpu:2 is in an unhealthy state"
+        )) == taxonomy.DEVICE_LOST
+
+    def test_neither_transient_nor_size_evidence(self):
+        # a dead host stays dead: retry and batch-halving both useless
+        assert taxonomy.HOST_LOST not in taxonomy.TRANSIENT
+        assert taxonomy.HOST_LOST not in taxonomy.SIZE_EVIDENCE
+
+
+class TestHostTopology:
+    @needs_mesh
+    def test_virtual_overlay_and_fallback(self):
+        mesh = pmesh.make_mesh(4)
+        devs = list(mesh.devices.flat)
+        with pmesh.virtual_hosts({int(devs[0].id): 7}):
+            assert pmesh.host_index(devs[0]) == 7
+            # devices absent from the map keep their real process index
+            assert pmesh.host_index(devs[1]) == int(devs[1].process_index)
+        assert pmesh.host_index(devs[0]) == int(devs[0].process_index)
+
+    @needs_mesh
+    def test_mesh_hosts_sorted_distinct(self):
+        mesh = pmesh.make_mesh(4)
+        with pmesh.virtual_hosts(_two_host_overlay(mesh)):
+            assert pmesh.mesh_hosts(mesh) == (0, 1)
+        assert pmesh.mesh_hosts(None) == ()
+
+    @needs_mesh
+    def test_lost_host_ids_needs_whole_host_dark(self, monkeypatch):
+        mesh = pmesh.make_mesh(4)
+        ids = [int(d.id) for d in mesh.devices.flat]
+        with pmesh.virtual_hosts(_two_host_overlay(mesh)):
+            assert pmesh.lost_host_ids(mesh) == ()
+            # one of host 1's devices dead: device loss, NOT host loss
+            monkeypatch.setattr(
+                pmesh, "live_device_ids",
+                lambda: frozenset(i for i in ids if i != ids[2]))
+            assert pmesh.lost_host_ids(mesh) == ()
+            # both of host 1's devices dead: the host is lost
+            monkeypatch.setattr(
+                pmesh, "live_device_ids",
+                lambda: frozenset(ids[:2]))
+            assert pmesh.lost_host_ids(mesh) == (1,)
+
+    @needs_mesh
+    def test_surviving_mesh_drops_named_host(self):
+        mesh = pmesh.make_mesh(4)
+        ids = [int(d.id) for d in mesh.devices.flat]
+        with pmesh.virtual_hosts(_two_host_overlay(mesh)):
+            new = pmesh.surviving_mesh(mesh, lost_hosts=[0])
+            assert new is not None
+            assert [int(d.id) for d in new.devices.flat] == ids[2:]
+
+    @needs_mesh
+    def test_unnamed_host_drops_last_devices_host(self):
+        mesh = pmesh.make_mesh(4)
+        ids = [int(d.id) for d in mesh.devices.flat]
+        with pmesh.virtual_hosts(_two_host_overlay(mesh)):
+            new = pmesh.surviving_mesh(mesh, unnamed="host")
+            assert new is not None
+            assert [int(d.id) for d in new.devices.flat] == ids[:2]
+
+    @needs_pod
+    def test_host_drop_preserves_model_axis(self):
+        # 4 hosts x 2 devices laid out (4, 2) data x model: losing one
+        # host leaves 6 survivors = 3 full model groups
+        mesh = pmesh.make_mesh(8, axis_names=("data", "model"),
+                               shape=(4, 2))
+        overlay = {int(d.id): k // 2
+                   for k, d in enumerate(mesh.devices.flat)}
+        with pmesh.virtual_hosts(overlay):
+            new = pmesh.surviving_mesh(mesh, lost_hosts=[1])
+            assert new is not None
+            assert dict(new.shape) == {"data": 3, "model": 2}
+
+    @needs_pod
+    def test_ragged_host_drop_trims_to_full_model_groups(self):
+        # 2 hosts x 3 devices, model=2: losing a host leaves 3
+        # survivors — only one full model group fits, the excess
+        # survivor is dropped rather than re-replicating tables
+        mesh = pmesh.make_mesh(6, axis_names=("data", "model"),
+                               shape=(3, 2))
+        overlay = {int(d.id): k // 3
+                   for k, d in enumerate(mesh.devices.flat)}
+        with pmesh.virtual_hosts(overlay):
+            new = pmesh.surviving_mesh(mesh, lost_hosts=[1])
+            assert new is not None
+            assert dict(new.shape) == {"data": 1, "model": 2}
+
+
+class TestMeshFingerprint:
+    @needs_mesh
+    def test_stable_across_rebuilds(self):
+        # a restarted coordinator rebuilding the same topology must
+        # compute the same fingerprint (journal + AOT cache reuse)
+        fp1 = pmesh.mesh_fingerprint(pmesh.make_mesh(4))
+        fp2 = pmesh.mesh_fingerprint(pmesh.make_mesh(4))
+        assert fp1 == fp2
+        overlay = _two_host_overlay(pmesh.make_mesh(4))
+        with pmesh.virtual_hosts(overlay):
+            fa = pmesh.mesh_fingerprint(pmesh.make_mesh(4))
+            fb = pmesh.mesh_fingerprint(pmesh.make_mesh(4))
+        assert fa == fb
+
+    @needs_mesh
+    def test_keyed_on_host_layout(self):
+        mesh = pmesh.make_mesh(4)
+        base = pmesh.mesh_fingerprint(mesh)
+        with pmesh.virtual_hosts(_two_host_overlay(mesh)):
+            split = pmesh.mesh_fingerprint(mesh)
+        assert base != split
+        # equality-only consumers aside, the host layout is the 4th leg
+        assert len(split) == 4 and split[:3] == base[:3]
+
+
+class TestShardRows:
+    def test_even_split(self):
+        assert hostshard.shard_rows(8, 2) == [(0, 4), (4, 8)]
+
+    def test_ragged_alignment_keeps_batch_boundaries(self):
+        # 12 rows in batches of 5 -> 3 units; 2 units to host 0
+        assert hostshard.shard_rows(12, 2, align=5) == [(0, 10), (10, 12)]
+
+    def test_hosts_past_the_work_get_empty_ranges(self):
+        rows = hostshard.shard_rows(3, 4, align=2)
+        assert rows == [(0, 2), (2, 3), (3, 3), (3, 3)]
+
+    def test_ranges_partition_exactly(self):
+        for n, nhosts, align in [(0, 2, 4), (7, 3, 2), (24, 5, 8)]:
+            rows = hostshard.shard_rows(n, nhosts, align)
+            assert rows[0][0] == 0 and rows[-1][1] == n
+            for (a, b), (c, d) in zip(rows, rows[1:]):
+                assert b == c and a <= b
+
+    def test_rejects_no_hosts(self):
+        with pytest.raises(ValueError):
+            hostshard.shard_rows(4, 0)
+
+
+class TestHostShardJournals:
+    MB = 3
+
+    def _dispatch_all(self, eng, pts, jdir, nhosts=2, tag="t1"):
+        for h in range(nhosts):
+            hostshard.dispatch_local_shard(
+                eng, pts, host=h, nhosts=nhosts, journal_dir=str(jdir),
+                tag=tag, engine_fp="fp-a", max_batch=self.MB)
+
+    def test_merge_bitwise_identical_to_single_process(self, tmp_path):
+        model, params, train = _setup()
+        eng = _engine(model, params, train)
+        pts = _unique_points(train, 8)
+        ref = hostshard._pack_result(
+            eng.query_many(pts, batch_queries=self.MB))
+        self._dispatch_all(eng, pts, tmp_path)
+        merged = hostshard.merge_host_shards(
+            str(tmp_path), "t1", 2, pts, engine_fp="fp-a",
+            max_batch=self.MB, timeout_s=5.0)
+        for key in ("scores", "counts", "ihvp", "test_grad"):
+            assert np.array_equal(np.asarray(merged[key]),
+                                  np.asarray(ref[key])), key
+        assert merged["offsets"][-1] == merged["scores"].size
+
+    def test_resume_skips_recompute(self, tmp_path, monkeypatch):
+        model, params, train = _setup(seed=1)
+        eng = _engine(model, params, train)
+        pts = _unique_points(train, 6)
+        self._dispatch_all(eng, pts, tmp_path)
+        # a restarted host must resume from its verified journal — if
+        # it recomputes, this engine now explodes
+        monkeypatch.setattr(eng, "query_many", _boom)
+        self._dispatch_all(eng, pts, tmp_path)
+
+    def test_missing_peer_times_out_classified(self, tmp_path):
+        model, params, train = _setup(seed=2)
+        eng = _engine(model, params, train)
+        pts = _unique_points(train, 6)
+        hostshard.dispatch_local_shard(
+            eng, pts, host=0, nhosts=2, journal_dir=str(tmp_path),
+            tag="t1", engine_fp="fp-a", max_batch=self.MB)
+        clock = rpolicy.VirtualClock()
+        with pytest.raises(taxonomy.HostLost) as ei:
+            hostshard.merge_host_shards(
+                str(tmp_path), "t1", 2, pts, engine_fp="fp-a",
+                max_batch=self.MB, timeout_s=1.0, clock=clock)
+        assert taxonomy.classify(ei.value) == taxonomy.HOST_LOST
+        assert "[1]" in str(ei.value)
+
+    def test_foreign_fingerprint_is_a_verified_miss(self, tmp_path):
+        # a journal from another engine generation must never merge
+        model, params, train = _setup(seed=3)
+        eng = _engine(model, params, train)
+        pts = _unique_points(train, 6)
+        self._dispatch_all(eng, pts, tmp_path)
+        with pytest.raises(taxonomy.HostLost):
+            hostshard.merge_host_shards(
+                str(tmp_path), "t1", 2, pts, engine_fp="fp-b",
+                max_batch=self.MB, timeout_s=0.0,
+                clock=rpolicy.VirtualClock())
+
+
+def _boom(*a, **kw):
+    raise AssertionError("resume path recomputed a journaled shard")
+
+
+@needs_mesh
+class TestServiceHostLossRecovery:
+    def _reference(self, model, params, train, pts):
+        svc = _service(_engine(model, params, train), max_batch=3,
+                       max_queue=64)
+        return {r.id: np.asarray(r.scores).copy()
+                for r in svc.run(_requests(pts))}
+
+    def test_host_loss_recovers_bit_identical(self):
+        model, params, train = _setup()
+        pts = _unique_points(train, 8)
+        ref = self._reference(model, params, train, pts)
+        mesh = pmesh.make_mesh(4)
+        with pmesh.virtual_hosts(_two_host_overlay(mesh)):
+            eng = _engine(model, params, train, mesh=mesh)
+            svc = _service(eng, max_batch=3, max_queue=64, mesh=mesh)
+            with inject.active(
+                inject.Fault("serve.dispatch", at=1,
+                             kind=taxonomy.HOST_LOST),
+                strict=True, validate=True,
+            ):
+                responses = svc.run(_requests(pts))
+            assert all(r.ok for r in responses)
+            for r in responses:
+                assert np.array_equal(np.asarray(r.scores), ref[r.id])
+            # a host-granular shrink: BOTH of the lost host's devices
+            # left the mesh at once
+            assert int(svc.mesh.devices.size) == 2
+            assert svc.rollup()["host_loss_recoveries"] == 1
+            assert svc.rollup()["device_loss_recoveries"] == 0
+
+    def test_meshless_host_loss_sheds_classified(self):
+        model, params, train = _setup(seed=1)
+        pts = _unique_points(train, 6)
+        svc = _service(_engine(model, params, train), max_batch=3,
+                       max_queue=64)
+        with inject.active(
+            inject.Fault("serve.dispatch", at=0,
+                         kind=taxonomy.HOST_LOST),
+            strict=True, validate=True,
+        ):
+            responses = svc.run(_requests(pts))
+        shed = [r for r in responses if not r.ok]
+        assert len(shed) == 3
+        assert all(r.reason == taxonomy.HOST_LOST for r in shed)
+
+
+@needs_mesh
+class TestConstructionLivenessNamesCulprits:
+    def test_whole_host_dark_raises_host_lost_with_members(
+            self, monkeypatch):
+        model, params, train = _setup()
+        mesh = pmesh.make_mesh(4)
+        ids = [int(d.id) for d in mesh.devices.flat]
+        with pmesh.virtual_hosts(_two_host_overlay(mesh)):
+            eng = _engine(model, params, train, mesh=mesh)
+            monkeypatch.setattr(pmesh, "live_device_ids",
+                                lambda: frozenset(ids[:2]))
+            with pytest.raises(taxonomy.HostLost) as ei:
+                _service(eng, mesh=mesh)
+        assert taxonomy.classify(ei.value) == taxonomy.HOST_LOST
+        # the classified error names exactly which members failed
+        assert sorted(ei.value.devices) == sorted(ids[2:])
+        assert ei.value.hosts == [1]
+        assert "host(s) [1]" in str(ei.value)
+
+    def test_partial_host_raises_device_lost(self, monkeypatch):
+        model, params, train = _setup()
+        mesh = pmesh.make_mesh(4)
+        ids = [int(d.id) for d in mesh.devices.flat]
+        with pmesh.virtual_hosts(_two_host_overlay(mesh)):
+            eng = _engine(model, params, train, mesh=mesh)
+            monkeypatch.setattr(
+                pmesh, "live_device_ids",
+                lambda: frozenset(i for i in ids if i != ids[3]))
+            with pytest.raises(taxonomy.DeviceLost) as ei:
+                _service(eng, mesh=mesh)
+        assert ei.value.devices == [ids[3]]
+        assert ei.value.hosts == []
+
+
+class TestHostRoleDispatch:
+    def test_two_host_roles_serve_reference_bytes(self, tmp_path):
+        model, params, train = _setup()
+        pts = _unique_points(train, 9)
+        ref = {r.id: np.asarray(r.scores).copy()
+               for r in _service(
+                   _engine(model, params, train), max_batch=3,
+                   max_queue=64).run(_requests(pts))}
+        eng = _engine(model, params, train)
+        # host 0 drains first: its merge times out waiting for host 1
+        # (which never ran) and ADOPTS that shard via the journals
+        svc0 = _service(eng, max_batch=3, max_queue=64,
+                        host_role=(0, 2, str(tmp_path)),
+                        host_merge_timeout_s=0.5,
+                        clock=rpolicy.VirtualClock())
+        r0 = svc0.run(_requests(pts))
+        assert all(r.ok for r in r0)
+        for r in r0:
+            assert np.array_equal(np.asarray(r.scores), ref[r.id])
+        assert svc0.rollup()["host_loss_recoveries"] == 1
+        # host 1 then RESUMES from the journals host 0 published for
+        # it — no adoption, no recompute, same bytes
+        svc1 = _service(eng, max_batch=3, max_queue=64,
+                        host_role=(1, 2, str(tmp_path)),
+                        host_merge_timeout_s=0.5,
+                        clock=rpolicy.VirtualClock())
+        r1 = svc1.run(_requests(pts))
+        assert all(r.ok for r in r1)
+        for r in r1:
+            assert np.array_equal(np.asarray(r.scores), ref[r.id])
+        assert svc1.rollup()["host_loss_recoveries"] == 0
+
+    def test_host_role_validates_index(self):
+        model, params, train = _setup()
+        eng = _engine(model, params, train)
+        with pytest.raises(ValueError):
+            _service(eng, host_role=(2, 2, "/tmp/x"))
+
+
+class TestClassDeadlines:
+    def test_true_resolves_published_slos(self):
+        model, params, train = _setup()
+        svc = _service(_engine(model, params, train),
+                       class_deadlines=True)
+        assert svc.class_deadlines == CLASS_SLOS
+        # slack derives from the tightest SLO when not pinned
+        assert svc.deadline_slack_s == pytest.approx(
+            0.25 * min(CLASS_SLOS.values()))
+
+    def test_dict_merges_over_slos_and_slack_stays_pinnable(self):
+        model, params, train = _setup()
+        svc = _service(_engine(model, params, train),
+                       class_deadlines={"batch": 5.0},
+                       deadline_slack_s=0.05)
+        assert svc.class_deadlines["batch"] == 5.0
+        assert svc.class_deadlines["interactive"] == (
+            CLASS_SLOS["interactive"])
+        assert svc.deadline_slack_s == 0.05
+
+    def test_off_by_default(self):
+        model, params, train = _setup()
+        svc = _service(_engine(model, params, train))
+        assert svc.class_deadlines is None
+        assert svc.deadline_slack_s is None
+
+    def test_ticket_budget_resolution_order(self):
+        adm = AdmissionController(class_deadlines={"interactive": 0.5},
+                                  default_deadline_s=9.0)
+        # explicit deadline wins over the class SLO
+        t = adm.ticket(Request(1, 1, cls="interactive", deadline_s=2.0),
+                       now=100.0)
+        assert t.t_deadline == pytest.approx(102.0)
+        # no explicit deadline: the class SLO applies
+        t = adm.ticket(Request(1, 1, cls="interactive"), now=100.0)
+        assert t.t_deadline == pytest.approx(100.5)
+        # classes without an SLO fall through to the global default
+        t = adm.ticket(Request(1, 1, cls="batch"), now=100.0)
+        assert t.t_deadline == pytest.approx(109.0)
+
+    def test_unknown_class_rejected(self):
+        with pytest.raises(ValueError):
+            AdmissionController(class_deadlines={"vip": 1.0})
